@@ -1,0 +1,56 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Capability parity with the Ray reference (tasks, actors, objects, placement
+groups, Train/Tune/Data/Serve/RLlib-equivalents) re-designed for TPU
+hardware: the device plane is JAX/XLA — workers own TPU chips, collectives
+ride ICI via ``jax.lax.p*`` under ``pjit``/``shard_map`` meshes, hot kernels
+are Pallas — while the runtime plane (scheduling, ownership, object store)
+stays host-side, as in the reference.
+
+Public surface mirrors ``python/ray/__init__.py``:
+
+    import ray_tpu as ray
+    ray.init()
+    @ray.remote
+    def f(x): return x + 1
+    ray.get(f.remote(1))
+"""
+
+from ray_tpu._private.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor, method
+from ray_tpu.remote_function import RemoteFunction, remote_decorator
+from ray_tpu.runtime_context import get_runtime_context
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=...)`` decorator
+    (reference: python/ray/_private/worker.py remote)."""
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return remote_decorator(None)(args[0])
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return remote_decorator(kwargs)
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
+    "available_resources", "ObjectRef", "ActorClass", "ActorHandle",
+    "RemoteFunction", "get_runtime_context", "exceptions", "__version__",
+]

@@ -1,0 +1,88 @@
+"""Runtime configuration flags.
+
+TPU-native equivalent of the reference's macro-generated config struct
+(reference: ``src/ray/common/ray_config_def.h:22`` — ``RAY_CONFIG(type, name,
+default)``, 780 lines of flags, overridable via ``RAY_<name>`` env vars).
+
+We keep the same two properties — one flat flag namespace, env-var override —
+but as a plain dataclass: every field can be overridden with
+``RAY_TPU_<FIELD_NAME>`` in the environment, and programmatically via
+``ray_tpu.init(_system_config={...})``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get("RAY_TPU_" + name.upper())
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+@dataclasses.dataclass
+class Config:
+    # Objects whose serialized size is below this are carried inline inside
+    # protocol messages; larger ones go to the shared-memory store.  The
+    # reference cutoff is 100KB (``max_direct_call_object_size``,
+    # ray_config_def.h:212); we default higher because host pipes on a TPU VM
+    # comfortably move 1MB messages and shm setup has fixed cost.
+    max_inline_object_size: int = 1024 * 1024
+
+    # Shared-memory store capacity (bytes).  0 = unlimited (bounded by
+    # /dev/shm).  Mirrors plasma's store size (object_manager/plasma/).
+    object_store_memory: int = 0
+
+    # Directory for shared-memory segments.
+    shm_dir: str = "/dev/shm"
+
+    # Seconds a worker may sit idle before the pool reaps it (reference:
+    # idle worker killing in worker_pool.cc).
+    idle_worker_timeout_s: float = 300.0
+
+    # Soft cap on extra workers spawned when existing workers block in
+    # ``ray.get`` (reference: worker cap w/ backoff, ray_config_def.h:174-187).
+    max_extra_blocked_workers: int = 16
+
+    # Task retry default (reference: max_retries=3 for normal tasks).
+    default_max_retries: int = 3
+
+    # Health-check cadence for worker processes (reference: GCS pull-based
+    # health checks, gcs_health_check_manager.h:39).
+    health_check_period_s: float = 5.0
+
+    # Wait this long for a worker process to start before declaring failure.
+    worker_start_timeout_s: float = 60.0
+
+    # Number of workers prestarted at init when num_cpus not yet demanded
+    # (reference: prestart in worker_pool.cc).
+    prestart_workers: int = 0
+
+    # Multiprocessing start method: "forkserver" is fastest that is still
+    # safe with JAX in the driver ("fork" is not — XLA runtime threads).
+    worker_start_method: str = "forkserver"
+
+    @classmethod
+    def from_env(cls, overrides: dict | None = None) -> "Config":
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            kwargs[f.name] = _env_override(f.name, f.default)
+        if overrides:
+            for k, v in overrides.items():
+                if k not in kwargs:
+                    raise ValueError(f"Unknown config flag: {k}")
+                kwargs[k] = v
+        return cls(**kwargs)
+
+
+GLOBAL_CONFIG = Config.from_env()

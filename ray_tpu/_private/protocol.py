@@ -1,0 +1,52 @@
+"""Driver ⇄ worker wire protocol.
+
+The reference speaks gRPC between core workers and raylets
+(``src/ray/rpc/``, ``core_worker.proto``, ``node_manager.proto``).  Our v1
+topology is one driver process + N worker processes per host, so the
+transport is a duplex OS pipe per worker (``multiprocessing.Pipe``) carrying
+pickled tuples — no serialization schema to keep in sync, and small-message
+latency (~10µs) far below gRPC's.  A TCP transport with the same message set
+slots in for multi-host (see node.py).
+
+Message grammar (first element = type tag):
+
+driver → worker
+  ("exec",   task: dict)            run a task / actor method
+  ("create_actor", spec: dict)      instantiate actor class on this worker
+  ("func",   func_id, payload)      function/class definition (cloudpickle)
+  ("obj",    req_id, ok, descr)     reply to a worker "get"
+  ("submitted", req_id)             ack of a nested "submit"
+  ("kill",   )                      graceful shutdown
+worker → driver
+  ("ready",  worker_id_hex, pid)
+  ("result", task_id_bytes, ok, returns: list[Descr], meta: dict)
+  ("get",    req_id, object_id_bytes, timeout)
+  ("need_func", func_id, task: dict)  exec bounced: definition not cached
+  ("submit", spec: dict)            nested task submission
+  ("put",    object_id_bytes, descr)
+  ("addref", object_id_bytes) / ("decref", object_id_bytes)
+  ("blocked", task_id_bytes) / ("unblocked", task_id_bytes)
+  ("actor_exit", actor_id_bytes, ok, error_descr)
+
+Object descriptors (Descr) carry values between processes:
+  ("inline", bytes)                 pickled value, small
+  ("shm", name, size)               shared-memory segment (zero-copy mmap)
+  ("error", bytes)                  pickled exception
+"""
+
+from __future__ import annotations
+
+import pickle
+
+
+def send(conn, msg: tuple):
+    conn.send_bytes(pickle.dumps(msg, protocol=5))
+
+
+def recv(conn) -> tuple:
+    return pickle.loads(conn.recv_bytes())
+
+
+INLINE = "inline"
+SHM = "shm"
+ERROR = "error"

@@ -1,0 +1,177 @@
+"""Shared-memory object segments — the plasma-store equivalent.
+
+The reference's plasma (``src/ray/object_manager/plasma/store.h``,
+``dlmalloc.cc``) is a single mmap arena with a malloc inside, served over a
+unix-socket protocol, one store per node, embedded in the raylet.  On a TPU
+VM the picture is simpler: host RAM is big, objects are mostly numpy/jax
+host arrays moving between one driver and a handful of worker processes on
+the same host.  So v1 uses one POSIX shm file per object under ``/dev/shm``
+— creation is O(1), cross-process attach is just open+mmap, and the kernel
+does refcounting of the mapping for us.  (A C++ arena allocator with the
+same API slots in behind this module later; see src/ in this repo.)
+
+Each segment:  [8B magic][8B meta_len][meta pickle][aligned buffers...]
+
+Zero-copy property: consumers ``mmap`` the file and reconstruct numpy/jax
+host arrays as views over the mapping — same guarantee plasma gives
+(``plasma/client.cc`` Get returns mmap'd buffers).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+
+_MAGIC = b"RTPUOBJ1"
+_HEADER = struct.Struct("<8sQ")  # magic, meta_len
+
+
+def _segment_path(shm_dir: str, name: str) -> str:
+    return os.path.join(shm_dir, name)
+
+
+class Segment:
+    """An open mapping of one shared object."""
+
+    __slots__ = ("name", "path", "size", "_mm", "_closed")
+
+    def __init__(self, name: str, path: str, size: int, mm: mmap.mmap):
+        self.name = name
+        self.path = path
+        self.size = size
+        self._mm = mm
+        self._closed = False
+
+    def deserialize(self) -> Any:
+        magic, meta_len = _HEADER.unpack_from(self._mm, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"Corrupt shm segment {self.name}")
+        view = memoryview(self._mm)
+        meta = bytes(view[_HEADER.size : _HEADER.size + meta_len])
+        # Buffer table is pickled inside meta as (offset, length) pairs by
+        # the writer; serialization.loads reconstructs via these views.
+        table_and_meta = serialization.loads_inline(meta)
+        offsets, lengths, payload = table_and_meta
+        buffers = [view[o : o + l] for o, l in zip(offsets, lengths)]
+        return serialization.loads(payload, buffers)
+
+    def close(self):
+        # The deserialized value may hold views into the mapping; mmap.close
+        # will fail with BufferError if so — let the GC of those arrays
+        # release it instead.
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+
+
+class ShmStore:
+    """Create/attach/unlink shared object segments on this node.
+
+    Reference analog: plasma store + client
+    (``src/ray/object_manager/plasma/store.h``, ``client.cc``).  Eviction is
+    the owner's job here (ownership-based freeing), not an LRU inside the
+    store — TPU training workloads want deterministic memory, not surprise
+    eviction of a batch mid-step.
+    """
+
+    def __init__(self, shm_dir: str = "/dev/shm", capacity: int = 0,
+                 session_id: str = ""):
+        self._dir = shm_dir if os.path.isdir(shm_dir) else "/tmp"
+        self._capacity = capacity
+        self._session = session_id or os.urandom(4).hex()
+        self._lock = threading.Lock()
+        self._used = 0
+        self._created: set[str] = set()
+
+    def segment_name(self, object_id: ObjectID) -> str:
+        return f"rtpu-{self._session}-{object_id.hex()}"
+
+    def create(self, object_id: ObjectID, value: Any) -> Tuple[str, int]:
+        """Serialize ``value`` into a new segment; returns (name, size)."""
+        meta, buffers = serialization.dumps(value)
+        sizes = [len(b) for b in buffers]
+        # Reserve space for the header + buffer table pickle.  The table is
+        # pickled together with the payload meta so readers need one load.
+        payload = (None, None, meta)  # placeholder to measure table size
+        # Two-pass: compute offsets assuming a table pickle of the final
+        # length.  Table size varies with offsets' magnitude only slightly;
+        # pad generously instead of iterating.
+        probe = serialization.dumps_inline(([0] * len(sizes), sizes, meta))
+        table_room = len(probe) + 256
+        base = _HEADER.size + table_room
+        offsets, total = serialization.aligned_offsets(sizes, base)
+        table = serialization.dumps_inline((offsets, sizes, meta))
+        if len(table) > table_room:
+            # Offsets grew the pickle beyond the pad (pathological); redo
+            # with exact room.
+            table_room = len(table) + 256
+            base = _HEADER.size + table_room
+            offsets, total = serialization.aligned_offsets(sizes, base)
+            table = serialization.dumps_inline((offsets, sizes, meta))
+
+        if self._capacity and self._used + total > self._capacity:
+            raise MemoryError(
+                f"Object store over capacity: need {total}, "
+                f"used {self._used}/{self._capacity}"
+            )
+
+        name = self.segment_name(object_id)
+        path = _segment_path(self._dir, name)
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, total)
+            mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        _HEADER.pack_into(mm, 0, _MAGIC, len(table))
+        mm[_HEADER.size : _HEADER.size + len(table)] = table
+        for off, buf in zip(offsets, buffers):
+            mm[off : off + len(buf)] = buf
+        mm.close()
+        with self._lock:
+            self._used += total
+            self._created.add(name)
+        return name, total
+
+    def attach(self, name: str) -> Segment:
+        path = _segment_path(self._dir, name)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            size = os.fstat(fd).st_size
+            mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        return Segment(name, path, size, mm)
+
+    def unlink(self, name: str, size: int = 0):
+        path = _segment_path(self._dir, name)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        with self._lock:
+            if name in self._created:
+                self._created.discard(name)
+                self._used -= size
+
+    def cleanup(self):
+        """Unlink everything this process created (driver shutdown path)."""
+        with self._lock:
+            names = list(self._created)
+            self._created.clear()
+            self._used = 0
+        for name in names:
+            try:
+                os.unlink(_segment_path(self._dir, name))
+            except OSError:
+                pass

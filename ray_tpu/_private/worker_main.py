@@ -1,0 +1,473 @@
+"""Worker process: execution loop + worker-side runtime.
+
+Reference analog: the worker half of the core worker
+(``src/ray/core_worker/core_worker.cc:2413`` RunTaskExecutionLoop +
+``python/ray/_raylet.pyx:702`` execute_task +
+``python/ray/_private/workers/default_worker.py``).
+
+A worker is a plain Python process wired to the driver by one duplex pipe.
+A reader thread demultiplexes incoming messages into (a) a task queue and
+(b) response slots for in-flight requests this worker made (object gets,
+nested submits).  Execution runs on the main thread; actors with
+``max_concurrency > 1`` get a thread pool, and ``async def`` actor methods
+run on a persistent asyncio loop (reference: async actors,
+``python/ray/_private/async_compat.py``).
+
+TPU ownership: if the driver granted this worker TPU chips, the spawn env
+carries ``TPU_VISIBLE_CHIPS``/``JAX_PLATFORMS`` so that when user code
+imports jax *inside this process* it sees exactly its chips — the TPU-native
+equivalent of the reference's CUDA_VISIBLE_DEVICES plumbing
+(``python/ray/_private/worker.py`` set_cuda_visible_devices).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+import queue
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID, new_task_id
+from ray_tpu._private import object_ref as object_ref_mod
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.shm_store import ShmStore
+from ray_tpu import exceptions as exc
+
+
+class _WorkerRuntime:
+    """Worker-side implementation of the runtime accessor used by ObjectRef
+    and the public API when running inside a worker."""
+
+    # Bounded caches: pooled workers and long-lived actors must not retain
+    # every task's results forever.
+    _CACHE_CAP = 64
+
+    def __init__(self, conn, send_lock, shm: ShmStore, max_inline: int):
+        self.conn = conn
+        self.send_lock = send_lock
+        self.shm = shm
+        self.max_inline = max_inline
+        self.req_counter = itertools.count(1)
+        self.pending: Dict[int, "queue.SimpleQueue"] = {}
+        self.pending_lock = threading.Lock()
+        # Per-thread task context: concurrent actor threads must not
+        # cross-contaminate (reference: per-thread context in worker.py).
+        self._tls = threading.local()
+        self.worker_id_hex = ""
+        self.node_id_hex = ""
+        self.job_id_hex = ""
+        self.assigned_resources: Dict[str, float] = {}
+        self.tpu_chips: list = []
+        # Objects fetched or created locally, cached: id -> value (LRU).
+        from collections import OrderedDict, deque as _deque
+
+        self._local_cache: "OrderedDict[ObjectID, Any]" = OrderedDict()
+        self._segments = _deque(maxlen=self._CACHE_CAP)
+
+    @property
+    def current_task_id(self) -> Optional[TaskID]:
+        return getattr(self._tls, "task_id", None)
+
+    @current_task_id.setter
+    def current_task_id(self, v):
+        self._tls.task_id = v
+
+    @property
+    def current_actor_id(self) -> Optional[ActorID]:
+        return getattr(self._tls, "actor_id", None)
+
+    @current_actor_id.setter
+    def current_actor_id(self, v):
+        self._tls.actor_id = v
+
+    def _cache_put(self, oid: ObjectID, value: Any):
+        self._local_cache[oid] = value
+        self._local_cache.move_to_end(oid)
+        while len(self._local_cache) > self._CACHE_CAP:
+            self._local_cache.popitem(last=False)
+
+    # -- plumbing ----------------------------------------------------------
+    def _send(self, msg):
+        with self.send_lock:
+            protocol.send(self.conn, msg)
+
+    def _request(self, msg_builder):
+        req_id = next(self.req_counter)
+        slot: "queue.SimpleQueue" = queue.SimpleQueue()
+        with self.pending_lock:
+            self.pending[req_id] = slot
+        self._send(msg_builder(req_id))
+        reply = slot.get()
+        with self.pending_lock:
+            self.pending.pop(req_id, None)
+        return reply
+
+    def deliver_reply(self, req_id, payload):
+        with self.pending_lock:
+            slot = self.pending.get(req_id)
+        if slot is not None:
+            slot.put(payload)
+
+    # -- descriptor handling ----------------------------------------------
+    def materialize(self, descr) -> Any:
+        kind = descr[0]
+        if kind == protocol.INLINE:
+            return serialization.loads_inline(descr[1])
+        if kind == protocol.SHM:
+            seg = self.shm.attach(descr[1])
+            self._segments.append(seg)
+            return seg.deserialize()
+        if kind == protocol.ERROR:
+            raise serialization.loads_inline(descr[1])
+        raise ValueError(f"bad descriptor {descr!r}")
+
+    def serialize_value(self, value: Any, object_id: ObjectID):
+        """Value -> descriptor, choosing inline vs shm by size."""
+        data = serialization.dumps_inline(value)
+        if len(data) <= self.max_inline:
+            return (protocol.INLINE, data)
+        name, size = self.shm.create(object_id, value)
+        return (protocol.SHM, name, size)
+
+    # -- runtime accessor API (mirrors driver Runtime) ---------------------
+    def add_local_reference(self, object_id: ObjectID):
+        self._send(("addref", object_id.binary()))
+
+    def remove_local_reference(self, object_id: ObjectID):
+        try:
+            self._send(("decref", object_id.binary()))
+        except Exception:
+            pass  # shutting down
+
+    def on_ref_serialized(self, object_id: ObjectID):
+        # Collect-only, like the driver: the carrying submit/put message
+        # lists these ids and the driver pins them on receipt.  Message FIFO
+        # per-connection guarantees the pin lands before this worker's own
+        # decref for the same ref can.
+        collector = getattr(self._tls, "ref_collector", None)
+        if collector is not None:
+            collector.append(object_id.binary())
+
+    def begin_ref_collection(self):
+        self._tls.ref_collector = []
+
+    def end_ref_collection(self) -> list:
+        out = getattr(self._tls, "ref_collector", None) or []
+        self._tls.ref_collector = None
+        return out
+
+    def get_objects(self, refs, timeout=None):
+        values = []
+        for ref in refs:
+            oid = ref.id()
+            if oid in self._local_cache:
+                values.append(self._local_cache[oid])
+                continue
+            tid = self.current_task_id
+            self._send(("blocked", tid.binary() if tid else b""))
+            try:
+                reply = self._request(
+                    lambda rid: ("get", rid, oid.binary(), timeout)
+                )
+            finally:
+                self._send(("unblocked", tid.binary() if tid else b""))
+            ok, descr = reply
+            if not ok:
+                raise self.materialize_error(descr)
+            values.append(self.materialize(descr))
+        return values
+
+    def materialize_error(self, descr):
+        try:
+            return serialization.loads_inline(descr[1])
+        except Exception:
+            return exc.RayTpuError("unknown error from driver")
+
+    def put_object(self, value) -> ObjectRef:
+        oid = ObjectID.for_put()
+        self.begin_ref_collection()
+        try:
+            descr = self.serialize_value(value, oid)
+        finally:
+            nested = self.end_ref_collection()
+        self._send(("put", oid.binary(), descr, nested))
+        self._cache_put(oid, value)
+        return ObjectRef(oid)
+
+    def submit_task(self, spec: dict) -> list:
+        """Nested task submission from inside a worker (reference: tasks may
+        spawn tasks; ownership stays with the driver in v1)."""
+        reply = self._request(lambda rid: ("submit", rid, spec))
+        assert reply == "ok", reply
+        tid = TaskID(spec["task_id"])
+        # _register=False: the driver counted this worker's reference at
+        # submission (see Runtime.submit_task_from_worker).
+        return [ObjectRef(tid.object_id(i), _register=False)
+                for i in range(spec["num_returns"])]
+
+    def wait_objects(self, refs, num_returns, timeout, fetch_local):
+        reply = self._request(
+            lambda rid: (
+                "wait",
+                rid,
+                [r.id().binary() for r in refs],
+                num_returns,
+                timeout,
+            )
+        )
+        ready_bin = set(reply)
+        ready = [r for r in refs if r.id().binary() in ready_bin]
+        not_ready = [r for r in refs if r.id().binary() not in ready_bin]
+        return ready, not_ready
+
+    def object_future(self, object_id):
+        raise RuntimeError("ObjectRef.future() is driver-only")
+
+    def is_worker(self):
+        return True
+
+
+_runtime: Optional[_WorkerRuntime] = None
+
+
+def get_worker_runtime() -> Optional[_WorkerRuntime]:
+    return _runtime
+
+
+class _FunctionCache:
+    def __init__(self):
+        self._fns: Dict[str, Any] = {}
+
+    def has(self, func_id: str) -> bool:
+        return func_id in self._fns
+
+    def put(self, func_id: str, payload: bytes):
+        self._fns[func_id] = serialization.loads_inline(payload)
+
+    def get(self, func_id: str):
+        return self._fns[func_id]
+
+
+def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
+             actors: Dict[bytes, Any]):
+    """Run one task/actor method; ship results back.
+
+    Reference: _raylet.pyx:702 execute_task — deserialize args, invoke,
+    store returns (small inline to owner, large to plasma/shm)."""
+    task_id = TaskID(task["task_id"])
+    rt.current_task_id = task_id
+    num_returns = task["num_returns"]
+    name = task.get("name", "task")
+    try:
+        args, kwargs = _load_args(rt, task)
+        if "actor_id" in task:
+            actor = actors[task["actor_id"]]
+            rt.current_actor_id = ActorID(task["actor_id"])
+            method = getattr(actor, task["method"])
+            result = method(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = _run_coroutine(result)
+        else:
+            fn = fns.get(task["func_id"])
+            result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = _run_coroutine(result)
+        returns = _pack_returns(rt, task_id, result, num_returns)
+        rt._send(("result", task["task_id"], True, returns, {}))
+    except Exception as e:  # noqa: BLE001 — task errors become objects
+        err = exc.TaskError.from_exception(name, e)
+        payload = _pickle_error(err)
+        returns = [(protocol.ERROR, payload)] * max(1, num_returns)
+        rt._send(("result", task["task_id"], False, returns, {}))
+    finally:
+        rt.current_task_id = None
+        rt.current_actor_id = None
+
+
+def _pickle_error(err):
+    try:
+        return serialization.dumps_inline(err)
+    except Exception:
+        # Exception not picklable — strip the cause, keep the traceback text.
+        err.cause = None
+        try:
+            return serialization.dumps_inline(err)
+        except Exception:
+            return serialization.dumps_inline(
+                exc.RayTpuError(f"unpicklable error: {err}")
+            )
+
+
+def _load_args(rt: _WorkerRuntime, task: dict):
+    args = [rt.materialize(d) for d in task["args"]]
+    kwargs = {k: rt.materialize(d) for k, d in task.get("kwargs", {}).items()}
+    return args, kwargs
+
+
+def _pack_returns(rt: _WorkerRuntime, task_id: TaskID, result, num_returns):
+    if num_returns == 1:
+        values = [result]
+    elif num_returns == 0:
+        values = []
+    else:
+        values = list(result)
+        if len(values) != num_returns:
+            raise ValueError(
+                f"Task declared num_returns={num_returns} but returned "
+                f"{len(values)} values"
+            )
+    out = []
+    for i, v in enumerate(values):
+        oid = task_id.object_id(i)
+        out.append(rt.serialize_value(v, oid))
+        rt._cache_put(oid, v)
+    return out
+
+
+_async_loop = None
+_async_loop_lock = threading.Lock()
+
+
+def _get_async_loop():
+    global _async_loop
+    with _async_loop_lock:
+        if _async_loop is None:
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(target=loop.run_forever, daemon=True,
+                                 name="ray_tpu-async")
+            t.start()
+            _async_loop = loop
+    return _async_loop
+
+
+def _run_coroutine(coro):
+    fut = asyncio.run_coroutine_threadsafe(coro, _get_async_loop())
+    return fut.result()
+
+
+def main():
+    """Subprocess entry: dial back to the driver's unix socket (reference:
+    python/ray/_private/workers/default_worker.py — raylet-spawned worker
+    connecting back over the raylet socket)."""
+    import time
+    from multiprocessing.connection import Client
+
+    address = os.environ["RAY_TPU_ADDRESS"]
+    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    conn = None
+    for attempt in range(20):
+        try:
+            conn = Client(address, authkey=authkey)
+            break
+        except (ConnectionError, OSError):
+            time.sleep(0.05 * (attempt + 1))
+    if conn is None:
+        raise SystemExit(1)
+    worker_entry(
+        conn,
+        os.environ["RAY_TPU_WORKER_ID"],
+        os.environ["RAY_TPU_SESSION"],
+        os.environ["RAY_TPU_SHM_DIR_OVERRIDE"],
+        int(os.environ["RAY_TPU_MAX_INLINE"]),
+        {},
+        os.environ["RAY_TPU_NODE_ID"],
+        os.environ["RAY_TPU_JOB_ID"],
+    )
+
+
+def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
+                 max_inline: int, env: Dict[str, str], node_id_hex: str,
+                 job_id_hex: str):
+    """Worker runtime setup + execution loop (reference:
+    core_worker.cc:2413 RunTaskExecutionLoop)."""
+    os.environ.update(env)
+    global _runtime
+    send_lock = threading.Lock()
+    shm = ShmStore(shm_dir=shm_dir, session_id=session)
+    rt = _WorkerRuntime(conn, send_lock, shm, max_inline)
+    rt.worker_id_hex = worker_id_hex
+    rt.node_id_hex = node_id_hex
+    rt.job_id_hex = job_id_hex
+    rt.tpu_chips = [
+        c for c in os.environ.get("TPU_VISIBLE_CHIPS", "").split(",") if c
+    ]
+    _runtime = rt
+    object_ref_mod._set_runtime_accessor(lambda: _runtime)
+
+    fns = _FunctionCache()
+    actors: Dict[bytes, Any] = {}
+    task_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+    pool: Optional[ThreadPoolExecutor] = None
+    max_concurrency = 1
+
+    def reader():
+        while True:
+            try:
+                msg = protocol.recv(conn)
+            except (EOFError, OSError):
+                os._exit(0)
+            tag = msg[0]
+            if tag in ("exec", "create_actor", "kill"):
+                task_queue.put(msg)
+            elif tag == "func":
+                fns.put(msg[1], msg[2])
+            elif tag == "obj":
+                rt.deliver_reply(msg[1], (msg[2], msg[3]))
+            elif tag == "submitted":
+                rt.deliver_reply(msg[1], "ok")
+            elif tag == "waited":
+                rt.deliver_reply(msg[1], msg[2])
+            elif tag == "reply":
+                rt.deliver_reply(msg[1], msg[2])
+
+    threading.Thread(target=reader, daemon=True, name="ray_tpu-reader").start()
+    protocol.send(conn, ("ready", worker_id_hex, os.getpid()))
+
+    while True:
+        msg = task_queue.get()
+        tag = msg[0]
+        if tag == "kill":
+            os._exit(0)
+        elif tag == "create_actor":
+            spec = msg[1]
+            rt.assigned_resources = spec.get("resources", {})
+            max_concurrency = spec.get("max_concurrency", 1)
+            if max_concurrency > 1:
+                pool = ThreadPoolExecutor(max_workers=max_concurrency)
+            try:
+                cls = fns.get(spec["func_id"])
+                args = [rt.materialize(d) for d in spec["args"]]
+                kwargs = {
+                    k: rt.materialize(d) for k, d in spec["kwargs"].items()
+                }
+                actor = cls(*args, **kwargs)
+                actors[spec["actor_id"]] = actor
+                rt._send(("result", spec["task_id"], True,
+                          [(protocol.INLINE,
+                            serialization.dumps_inline(None))], {}))
+            except Exception as e:  # noqa: BLE001
+                err = exc.TaskError.from_exception(
+                    spec.get("name", "actor.__init__"), e)
+                rt._send(("result", spec["task_id"], False,
+                          [(protocol.ERROR, _pickle_error(err))], {}))
+        elif tag == "exec":
+            task = msg[1]
+            rt.assigned_resources = task.get("resources",
+                                             rt.assigned_resources)
+            if pool is not None and "actor_id" in task:
+                pool.submit(_execute, rt, fns, task, actors)
+            else:
+                _execute(rt, fns, task, actors)
+
+
+if __name__ == "__main__":
+    # Run through the canonical module so module globals (the worker runtime
+    # singleton) live in ray_tpu._private.worker_main, not __main__.
+    from ray_tpu._private.worker_main import main as _canonical_main
+
+    _canonical_main()

@@ -1,0 +1,107 @@
+"""User-facing exception hierarchy.
+
+Parity with the reference's ``python/ray/exceptions.py`` (RayError,
+RayTaskError, RayActorError, ObjectLostError, GetTimeoutError, ...).  The
+semantics mirror the ownership model: a task failure is delivered to whoever
+``get``s any of its return objects (reference:
+``src/ray/core_worker/task_manager.h:90`` stores errors as objects).
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+# Alias matching the reference spelling for drop-in familiarity.
+RayError = RayTpuError
+
+
+class TaskError(RayTpuError):
+    """A remote task raised; re-raised at the caller's ``get``
+    (reference: python/ray/exceptions.py RayTaskError)."""
+
+    def __init__(self, function_name: str, cause_repr: str, tb_str: str,
+                 cause: BaseException | None = None):
+        self.function_name = function_name
+        self.cause_repr = cause_repr
+        self.tb_str = tb_str
+        self.cause = cause
+        super().__init__(self._format())
+
+    def _format(self) -> str:
+        return (
+            f"Task {self.function_name} failed.\n"
+            f"{self.tb_str}"
+        )
+
+    def __reduce__(self):
+        return (TaskError, (self.function_name, self.cause_repr,
+                            self.tb_str, self.cause))
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: BaseException) -> "TaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        # Keep the original exception when it pickles cleanly so callers can
+        # except on its type; fall back to the repr otherwise.
+        return cls(function_name, repr(exc), tb, cause=exc)
+
+
+RayTaskError = TaskError
+
+
+class ActorError(RayTpuError):
+    """The actor died before or during this call
+    (reference: python/ray/exceptions.py RayActorError)."""
+
+
+RayActorError = ActorError
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    """Worker process died while executing a task
+    (reference: WORKER_DIED error type in common.proto)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object's value is unrecoverable (owner gone, store evicted and no
+    lineage)."""
+
+
+class ObjectFreedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``ray.get(timeout=...)`` expired."""
+
+
+class TaskCancelledError(RayTpuError):
+    """Task was cancelled with ``ray.cancel``."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass

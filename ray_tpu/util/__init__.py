@@ -1,0 +1,16 @@
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+    placement_group_table,
+    PlacementGroup,
+)
+from ray_tpu.util.scheduling_strategies import (
+    PlacementGroupSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+)
+
+__all__ = [
+    "placement_group", "remove_placement_group", "placement_group_table",
+    "PlacementGroup", "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+]

@@ -1,0 +1,231 @@
+"""Actor tests (reference model: python/ray/tests/test_actor.py,
+test_actor_failures.py)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu as ray
+
+
+def test_basic_actor(ray_start_regular):
+    @ray.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray.get(c.inc.remote(), timeout=30) == 11
+    assert ray.get([c.inc.remote() for _ in range(5)]) == [12, 13, 14, 15, 16]
+
+
+def test_actor_method_ordering(ray_start_regular):
+    @ray.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return list(self.items)
+
+    a = Appender.remote()
+    refs = [a.add.remote(i) for i in range(20)]
+    assert ray.get(refs[-1], timeout=30) == list(range(20))
+
+
+def test_actor_error(ray_start_regular):
+    @ray.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method error")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(ray.exceptions.TaskError):
+        ray.get(b.fail.remote(), timeout=30)
+    # actor survives method errors
+    assert ray.get(b.ok.remote(), timeout=30) == 1
+
+
+def test_actor_constructor_error(ray_start_regular):
+    @ray.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("ctor boom")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(b.m.remote(), timeout=30)
+
+
+def test_actor_death_and_restart(ray_start_regular):
+    @ray.remote(max_restarts=1)
+    class Flaky:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    f = Flaky.remote()
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(f.crash.remote(), timeout=30)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert ray.get(f.ping.remote(), timeout=10) == "pong"
+            break
+        except ray.exceptions.RayTpuError:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_actor_no_restart_stays_dead(ray_start_regular):
+    @ray.remote
+    class Once:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    o = Once.remote()
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(o.crash.remote(), timeout=30)
+    time.sleep(0.5)
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(o.ping.remote(), timeout=30)
+
+
+def test_ray_kill(ray_start_regular):
+    @ray.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray.get(v.ping.remote(), timeout=30) == "pong"
+    ray.kill(v)
+    time.sleep(0.5)
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(v.ping.remote(), timeout=30)
+
+
+def test_named_actor(ray_start_regular):
+    @ray.remote
+    class Registry:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+
+        def get(self, k):
+            return self.d.get(k)
+
+    r = Registry.options(name="reg").remote()
+    ray.get(r.set.remote("a", 1), timeout=30)
+    r2 = ray.get_actor("reg")
+    assert ray.get(r2.get.remote("a"), timeout=30) == 1
+    with pytest.raises(ValueError):
+        ray.get_actor("missing")
+
+
+def test_get_if_exists(ray_start_regular):
+    @ray.remote
+    class Singleton:
+        def whoami(self):
+            return id(self)
+
+    a = Singleton.options(name="s", get_if_exists=True).remote()
+    b = Singleton.options(name="s", get_if_exists=True).remote()
+    ia = ray.get(a.whoami.remote(), timeout=30)
+    ib = ray.get(b.whoami.remote(), timeout=30)
+    assert ia == ib
+
+
+def test_actor_handle_in_task(ray_start_regular):
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self, k):
+            self.n += k
+            return self.n
+
+    @ray.remote
+    def bump(counter, k):
+        return ray.get(counter.inc.remote(k))
+
+    c = Counter.remote()
+    assert ray.get(bump.remote(c, 5), timeout=60) == 5
+    assert ray.get(bump.remote(c, 2), timeout=60) == 7
+
+
+def test_actor_creates_actor(ray_start_regular):
+    @ray.remote
+    class Child:
+        def val(self):
+            return 7
+
+    @ray.remote
+    class Parent:
+        def spawn(self):
+            child = Child.remote()
+            return ray.get(child.val.remote())
+
+    p = Parent.remote()
+    assert ray.get(p.spawn.remote(), timeout=60) == 7
+
+
+def test_async_actor(ray_start_regular):
+    @ray.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert ray.get(a.work.remote(21), timeout=30) == 42
+
+
+def test_max_concurrency(ray_start_regular):
+    @ray.remote(max_concurrency=4)
+    class Slow:
+        def work(self):
+            time.sleep(0.3)
+            return 1
+
+    s = Slow.remote()
+    t0 = time.monotonic()
+    ray.get([s.work.remote() for _ in range(4)], timeout=30)
+    elapsed = time.monotonic() - t0
+    # 4 concurrent 0.3s calls should take ~0.3s, not 1.2s
+    assert elapsed < 1.0, elapsed
+
+
+def test_method_num_returns(ray_start_regular):
+    @ray.remote
+    class M:
+        @ray.method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+    m = M.remote()
+    a, b = m.two.remote()
+    assert ray.get([a, b], timeout=30) == [1, 2]
