@@ -1,0 +1,118 @@
+"""Round benchmark: mirrors the reference's microbenchmark harness
+(`python/ray/_private/ray_perf.py:93`, numbers in BASELINE.md) on this
+framework's core runtime, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+value/vs_baseline = geometric mean of (ours / reference-published) over the
+core task/actor/object microbenchmarks — 1.0 is parity with the numbers the
+reference repo publishes for itself (release_logs/2.3.0/microbenchmark.json).
+Per-metric results go to stderr for the curious.
+"""
+
+import json
+import sys
+import time
+
+
+# Reference-published means (BASELINE.md, release_logs/2.3.0).
+BASELINE = {
+    "single_client_tasks_sync": 1304.0,
+    "single_client_tasks_async": 11031.0,
+    "one_one_actor_calls_sync": 2142.0,
+    "one_one_actor_calls_async": 8099.0,
+    "one_n_actor_calls_async": 10962.0,
+    "single_client_put_gigabytes": 20.4,
+}
+
+
+def timeit(fn, n, warmup=50):
+    fn(min(warmup, n))
+    t0 = time.perf_counter()
+    fn(n)
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    import ray_tpu as ray
+    # 8 worker-pool CPUs for tasks + 9 actors (1 CPU each) below.
+    ray.init(num_cpus=17)
+
+    @ray.remote
+    def f():
+        return None
+
+    @ray.remote
+    class Actor:
+        def m(self):
+            return None
+
+    results = {}
+
+    def tasks_sync(n):
+        for _ in range(n):
+            ray.get(f.remote())
+
+    results["single_client_tasks_sync"] = timeit(tasks_sync, 300, 30)
+
+    def tasks_async(n):
+        ray.get([f.remote() for _ in range(n)])
+
+    results["single_client_tasks_async"] = timeit(tasks_async, 3000)
+
+    a = Actor.remote()
+    ray.get(a.m.remote())
+
+    def actor_sync(n):
+        for _ in range(n):
+            ray.get(a.m.remote())
+
+    results["one_one_actor_calls_sync"] = timeit(actor_sync, 1000)
+
+    def actor_async(n):
+        ray.get([a.m.remote() for _ in range(n)])
+
+    results["one_one_actor_calls_async"] = timeit(actor_async, 3000)
+
+    actors = [Actor.remote() for _ in range(8)]
+    ray.get([b.m.remote() for b in actors])
+
+    def one_n_async(n):
+        per = n // len(actors)
+        ray.get([b.m.remote() for b in actors for _ in range(per)])
+
+    results["one_n_actor_calls_async"] = timeit(one_n_async, 4000)
+
+    import numpy as np
+    arr = np.zeros(1024 * 1024 * 100, dtype=np.uint8)  # 100 MB
+
+    def put_gb(n):
+        for _ in range(n):
+            ray.put(arr)
+
+    gb = len(arr) / 1e9
+    rate = timeit(put_gb, 20, 2)
+    results["single_client_put_gigabytes"] = rate * gb
+
+    ray.shutdown()
+
+    ratios = []
+    for k, v in results.items():
+        r = v / BASELINE[k]
+        ratios.append(r)
+        print(f"  {k}: {v:.1f} (ref {BASELINE[k]:.1f}, {r:.2f}x)",
+              file=sys.stderr)
+    geo = 1.0
+    for r in ratios:
+        geo *= r
+    geo **= 1.0 / len(ratios)
+    print(json.dumps({
+        "metric": "core_microbench_geomean_vs_reference",
+        "value": round(geo, 4),
+        "unit": "x (1.0 = reference-published parity)",
+        "vs_baseline": round(geo, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

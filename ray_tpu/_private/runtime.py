@@ -263,7 +263,13 @@ class Runtime:
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         self.nodes: Dict[NodeID, NodeState] = {}
         self.node_order: List[NodeID] = []
-        self.pending_tasks: deque = deque()  # resource-waiting TaskRecords
+        # Resource-waiting TaskRecords, bucketed by scheduling class
+        # (resource shape + strategy) so dispatch is O(#classes), not
+        # O(#queued): scanning a class stops at its first unplaceable
+        # head — same-shaped tasks behind it cannot place either.
+        # (Reference: per-SchedulingKey lease queues in
+        # direct_task_transport.h:75 / scheduling classes.)
+        self.pending_tasks: Dict[tuple, deque] = {}
         self.functions: Dict[str, bytes] = {}
         self.worker_funcs: Dict[int, set] = {}  # conn fileno -> func_ids sent
         self.task_events: deque = deque(maxlen=10000)
@@ -607,7 +613,7 @@ class Runtime:
             if "actor_id" in spec:
                 self._enqueue_actor_task_locked(rec)
             elif rec.deps_pending == 0:
-                self.pending_tasks.append(rec)
+                self._enqueue_pending_locked(rec)
                 self._dispatch_locked()
         for i in range(spec["num_returns"]):
             refs.append(ObjectRef(tid.object_id(i), _register=False))
@@ -645,7 +651,7 @@ class Runtime:
                 if rec.actor_id is not None:
                     self._pump_actor_locked(self.actors[rec.actor_id])
                 else:
-                    self.pending_tasks.append(rec)
+                    self._enqueue_pending_locked(rec)
                     self._dispatch_locked()
 
     # -------------------------------------------------------- scheduling --
@@ -696,48 +702,63 @@ class Runtime:
                 return node
         return None
 
+    def _sched_class(self, rec: "TaskRecord") -> tuple:
+        strategy = rec.spec.get("scheduling_strategy")
+        # pg targeting is already covered by (pg_id, bundle_index); for the
+        # rest (node_affinity/spread) the whole tuple keys the class.
+        skey = None if strategy and strategy[0] == "placement_group" \
+            else repr(strategy)
+        return (tuple(sorted(rec.requirements.items())),
+                rec.pg_id, rec.bundle_index, skey)
+
+    def _enqueue_pending_locked(self, rec: "TaskRecord"):
+        self.pending_tasks.setdefault(
+            self._sched_class(rec), deque()).append(rec)
+
     def _dispatch_locked(self):
         if self._stopped:
             return
         if self.pending_pgs:
             self._try_reserve_pgs_locked()
-        still_pending = deque()
-        while self.pending_tasks:
-            rec = self.pending_tasks.popleft()
-            if rec.cancelled or rec.dispatched:
-                continue
-            node = self._pick_node_locked(rec)
-            if node is None:
-                still_pending.append(rec)
-                continue
-            use_pg = rec.pg_id is not None
-            if use_pg:
-                pg = self.placement_groups.get(rec.pg_id)
-                self._pg_acquire_locked(pg, rec.bundle_index or 0,
-                                        rec.requirements)
-            else:
-                node.acquire(rec.requirements)
-            tpu_chips = []
-            n_tpu = int(rec.requirements.get("TPU", 0))
-            if n_tpu > 0:
-                if len(node.tpu_free) < n_tpu:
-                    # Chips still attached to retiring workers; try later.
-                    if use_pg:
-                        self._pg_release_locked(pg, rec.bundle_index or 0,
-                                                rec.requirements)
-                    else:
-                        node.release(rec.requirements)
-                    still_pending.append(rec)
+        for key in list(self.pending_tasks):
+            q = self.pending_tasks.get(key)
+            while q:
+                rec = q[0]
+                if rec.cancelled or rec.dispatched:
+                    q.popleft()
                     continue
-                tpu_chips = node.tpu_free[:n_tpu]
-                node.tpu_free = node.tpu_free[n_tpu:]
-            rec.node = node
-            worker = self._lease_worker_locked(node, rec, tpu_chips)
-            rec.worker = worker
-            rec.dispatched = True
-            worker.current = rec
-            self._send_task(worker, rec)
-        self.pending_tasks = still_pending
+                node = self._pick_node_locked(rec)
+                if node is None:
+                    break   # same class behind it cannot place either
+                use_pg = rec.pg_id is not None
+                if use_pg:
+                    pg = self.placement_groups.get(rec.pg_id)
+                    self._pg_acquire_locked(pg, rec.bundle_index or 0,
+                                            rec.requirements)
+                else:
+                    node.acquire(rec.requirements)
+                tpu_chips = []
+                n_tpu = int(rec.requirements.get("TPU", 0))
+                if n_tpu > 0:
+                    if len(node.tpu_free) < n_tpu:
+                        # Chips still attached to retiring workers.
+                        if use_pg:
+                            self._pg_release_locked(pg, rec.bundle_index or 0,
+                                                    rec.requirements)
+                        else:
+                            node.release(rec.requirements)
+                        break
+                    tpu_chips = node.tpu_free[:n_tpu]
+                    node.tpu_free = node.tpu_free[n_tpu:]
+                q.popleft()
+                rec.node = node
+                worker = self._lease_worker_locked(node, rec, tpu_chips)
+                rec.worker = worker
+                rec.dispatched = True
+                worker.current = rec
+                self._send_task(worker, rec)
+            if not q:
+                self.pending_tasks.pop(key, None)
 
     def _env_key_for(self, rec: TaskRecord, tpu_chips) -> str:
         env = rec.spec.get("runtime_env") or {}
@@ -962,7 +983,7 @@ class Runtime:
             self.tasks[spec["task_id"]] = rec
             self._resolve_deps_locked(rec)
             if rec.deps_pending == 0:
-                self.pending_tasks.append(rec)
+                self._enqueue_pending_locked(rec)
                 self._dispatch_locked()
         return actor_id
 
@@ -1314,7 +1335,7 @@ class Runtime:
             if "actor_id" in spec:
                 self._enqueue_actor_task_locked(rec)
             elif rec.deps_pending == 0:
-                self.pending_tasks.append(rec)
+                self._enqueue_pending_locked(rec)
                 self._dispatch_locked()
 
     def _on_worker_get(self, worker: WorkerHandle, rid, oid_bin, timeout):
@@ -1476,7 +1497,7 @@ class Runtime:
                     rec.dispatched = False
                     rec.worker = None
                     self.tasks[rec.spec["task_id"]] = rec
-                    self.pending_tasks.append(rec)
+                    self._enqueue_pending_locked(rec)
                 else:
                     self.tasks.pop(rec.spec["task_id"], None)
                     err = exc.WorkerCrashedError(
@@ -1539,7 +1560,7 @@ class Runtime:
             tid = TaskID(spec["task_id"])
             self.objects[tid.object_id(0)] = ObjectState(tid)
             self.tasks[spec["task_id"]] = rec
-            self.pending_tasks.append(rec)
+            self._enqueue_pending_locked(rec)
             self._dispatch_locked()
         else:
             actor.status = DEAD
@@ -1612,6 +1633,15 @@ class Runtime:
                 return
             rec.cancelled = True
             if not rec.dispatched:
+                # Drop the record from its scheduling-class queue now —
+                # dispatch stops at an unplaceable class head, so cancelled
+                # records behind it would otherwise be retained forever.
+                q = self.pending_tasks.get(self._sched_class(rec))
+                if q is not None:
+                    try:
+                        q.remove(rec)
+                    except ValueError:
+                        pass
                 self._fail_task_locked(rec, exc.TaskCancelledError(
                     rec.spec.get("name", "task")))
             elif force and rec.worker is not None:

@@ -1,0 +1,379 @@
+"""Flagship model family: Llama-style decoder LM (dense or MoE), TPU-first.
+
+Pure-functional design: params are a pytree of arrays, every tensor
+dimension has a *logical axis name*, and one rules table
+(``parallel.sharding.DEFAULT_RULES``) maps names to mesh axes — so the same
+model runs DP, FSDP, 2D (fsdp x tp), MoE-EP, or sequence-parallel by
+swapping rules, never editing model code.
+
+TPU-first choices:
+- layers are *stacked* on a leading "layer" dim and driven by ``lax.scan``
+  (+``jax.checkpoint``): one trace/compile of a single layer regardless of
+  depth, rematerialized backward to trade FLOPs for HBM.
+- bf16 activations/params with f32 RMSNorm stats and f32 logits/loss — the
+  MXU-native recipe.
+- attention is pluggable: pallas flash (ops/attention.py), ring over 'sp'
+  (ops/ring_attention.py), Ulysses all-to-all, or the XLA reference — all
+  numerically interchangeable (tested).
+- MoE layers use the dense-dispatch router (ops/moe.py); expert tensors are
+  sharded over 'ep' so XLA lowers dispatch/combine to ICI all-to-alls.
+
+Reference counterpart: none in Ray core (no tensor ops); RLlib's model zoo
+(``rllib/models/catalog.py``) plays the "models shipped with the framework"
+role, and its JAX support is a 299-LoC stub (``rllib/models/jax/``) — cited
+for parity, not design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.ulysses import ulysses_attention
+from ray_tpu.ops.layers import (
+    rms_norm, rope, apply_rope, swiglu, repeat_kv_heads,
+)
+from ray_tpu.ops.moe import moe_ffn
+from ray_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES, LogicalAxisRules, with_logical_constraint,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    embed_dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    mlp_dim: int = 11008
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "flash"          # flash | ring | ulysses | reference
+    num_experts: int = 0              # 0 = dense FFN
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    remat: bool = True
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        return LlamaConfig(embed_dim=5120, num_layers=40, num_heads=40,
+                           num_kv_heads=40, mlp_dim=13824, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """CI-sized config: runs on one CPU device in seconds."""
+        defaults = dict(vocab_size=256, embed_dim=64, num_layers=2,
+                        num_heads=4, num_kv_heads=4, head_dim=16, mlp_dim=128,
+                        max_seq_len=64, dtype=jnp.float32, remat=False,
+                        attn_impl="reference")
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+
+def _dense_layer_shapes(cfg: LlamaConfig) -> Dict[str, Tuple[Tuple[int, ...],
+                                                             Tuple]]:
+    """name -> (shape-per-layer, logical axes incl. the stacked 'layer' dim)."""
+    d, h, kvd, m = cfg.embed_dim, cfg.qkv_dim, cfg.kv_dim, cfg.mlp_dim
+    shapes = {
+        "attn_norm": ((d,), ("layer", "embed")),
+        "wq": ((d, h), ("layer", "kernel_in", "heads")),
+        "wk": ((d, kvd), ("layer", "kernel_in", "kv_heads")),
+        "wv": ((d, kvd), ("layer", "kernel_in", "kv_heads")),
+        "wo": ((h, d), ("layer", "heads", "kernel_in")),
+        "mlp_norm": ((d,), ("layer", "embed")),
+    }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        shapes.update({
+            "router": ((d, e), ("layer", "kernel_in", None)),
+            "w_gate": ((e, d, m), ("layer", "expert", "kernel_in", "mlp")),
+            "w_up": ((e, d, m), ("layer", "expert", "kernel_in", "mlp")),
+            "w_down": ((e, m, d), ("layer", "expert", "mlp", "kernel_in")),
+        })
+    else:
+        shapes.update({
+            "w_gate": ((d, m), ("layer", "kernel_in", "mlp")),
+            "w_up": ((d, m), ("layer", "kernel_in", "mlp")),
+            "w_down": ((m, d), ("layer", "mlp", "kernel_in")),
+        })
+    return shapes
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    layers = {k: ax for k, (_, ax) in _dense_layer_shapes(cfg).items()}
+    return {
+        "embed": ("vocab", "kernel_in"),
+        "layers": layers,
+        "final_norm": ("embed",),
+        "lm_head": ("kernel_in", "vocab"),
+    }
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Scaled-normal init (fan-in), params in ``cfg.param_dtype``."""
+    shapes = _dense_layer_shapes(cfg)
+    n_tensors = len(shapes) + 3
+    keys = iter(jax.random.split(key, n_tensors))
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.param_dtype)
+
+    layers = {}
+    for name, (shape, _) in shapes.items():
+        full = (cfg.num_layers,) + shape
+        if name.endswith("norm"):
+            layers[name] = jnp.ones(full, cfg.param_dtype)
+        else:
+            fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+            layers[name] = norm_init(next(keys), full, fan_in)
+    return {
+        "embed": norm_init(next(keys), (cfg.vocab_size, cfg.embed_dim), 1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.embed_dim,), cfg.param_dtype),
+        "lm_head": norm_init(next(keys), (cfg.embed_dim, cfg.vocab_size),
+                             cfg.embed_dim),
+    }
+
+
+def _attention(q, k, v, cfg: LlamaConfig, mesh: Optional[Mesh]):
+    """Dispatch to the configured attention impl.
+
+    Pallas kernels have no SPMD partitioning rule, so under a mesh the flash
+    path runs inside shard_map (batch over (dp,fsdp), heads over tp); ring /
+    ulysses manage the 'sp' axis themselves.
+    """
+    impl = cfg.attn_impl
+    if mesh is None:
+        # Ring/ulysses degenerate to plain attention on one device.
+        if impl == "flash":
+            return flash_attention(q, k, v, causal=True)
+        return mha_reference(q, k, v, causal=True)
+    if impl == "ring":
+        return ring_attention(q, k, v, causal=True, mesh=mesh)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, causal=True, mesh=mesh)
+    if impl == "reference":
+        return mha_reference(q, k, v, causal=True)
+    # flash under a mesh: pallas has no SPMD partitioning rule, so run the
+    # kernel per-shard: batch over (dp,fsdp), heads over tp, seq replicated.
+    from ray_tpu.parallel.sharding import manual_shard_map
+    k, v = repeat_kv_heads(q, k, v)
+    spec = P((AXIS_DP, AXIS_FSDP), None, AXIS_TP, None)
+    fn = manual_shard_map(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True),
+        {AXIS_DP, AXIS_FSDP, AXIS_TP}, in_specs=(spec, spec, spec),
+        out_specs=spec, mesh=mesh)
+    return fn(q, k, v)
+
+
+def _attention_sp_manual(q, k, v, cfg: LlamaConfig):
+    """Attention inside an already-manual 'sp' region (pipeline path):
+    call the sharded bodies inline — no nested shard_map."""
+    from ray_tpu.ops.ring_attention import _ring_attention_sharded
+    from ray_tpu.ops.ulysses import _ulysses_sharded
+    k, v = repeat_kv_heads(q, k, v)
+    sm_scale = cfg.head_dim ** -0.5
+    if cfg.attn_impl == "ulysses":
+        return _ulysses_sharded(q, k, v, sm_scale, True, AXIS_SP,
+                                use_flash=False)
+    return _ring_attention_sharded(q, k, v, sm_scale, True, AXIS_SP)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig, *,
+            mesh: Optional[Mesh] = None,
+            rules: Optional[LogicalAxisRules] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """tokens: (batch, seq) int32 -> (logits f32 (b, s, vocab), aux_loss).
+
+    Global-view path: call under jit with a mesh context; sharding
+    constraints steer XLA's partitioner.  (The pipeline-parallel path is
+    ``parallel.pipeline.forward_pipelined`` — manual SPMD.)
+    """
+    cst = _make_cst(mesh, rules)
+    b, s = tokens.shape
+    if mesh is not None:
+        # One-hot matmul instead of gather: with a ('vocab','embed')-sharded
+        # table this lowers to a local matmul + psum over 'tp' — the gather
+        # form makes the SPMD partitioner fully rematerialize the table.
+        onehot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+        x = onehot @ params["embed"].astype(cfg.dtype)
+    else:
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = cst(x, ("batch", "seq", "embed"))
+    layer_fn = _make_layer_fn(cfg, mesh, rules)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    (x, aux), _ = jax.lax.scan(layer_fn, (x, jnp.zeros((), jnp.float32)),
+                               params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = cst(logits, ("batch", "seq", "vocab"))
+    return logits, aux / cfg.num_layers
+
+
+def _make_cst(mesh, rules):
+    if mesh is None:
+        return lambda x, ax: x
+    return lambda x, ax: with_logical_constraint(x, ax, rules=rules)
+
+
+def _make_layer_fn(cfg: LlamaConfig, mesh, rules, sp_manual: bool = False):
+    """One transformer layer as a scan body over stacked layer params.
+    Shapes are read off the activation so the same body serves the full
+    batch (forward) and microbatches (forward_pipelined).
+
+    ``sp_manual``: the body runs inside a shard_map that is manual over
+    'sp' (the pipeline path — jax/shardy cannot nest manual regions): the
+    seq dim is device-local, RoPE uses the rank's global offset, and
+    ring/ulysses attention run inline over the bound 'sp' axis.
+    """
+    cst = _make_cst(mesh, rules)
+
+    def layer_fn(carry, lp):
+        x, aux = carry
+        b, s = x.shape[0], x.shape[1]
+        offset = 0
+        if sp_manual:
+            offset = jax.lax.axis_index(AXIS_SP) * s
+        cos, sin = rope(s, cfg.head_dim, cfg.rope_theta, offset=offset)
+        h = rms_norm(x, lp["attn_norm"])
+        q = (h @ lp["wq"].astype(cfg.dtype)).reshape(
+            b, s, cfg.num_heads, cfg.head_dim)
+        k = (h @ lp["wk"].astype(cfg.dtype)).reshape(
+            b, s, cfg.num_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"].astype(cfg.dtype)).reshape(
+            b, s, cfg.num_kv_heads, cfg.head_dim)
+        q = cst(apply_rope(q, cos, sin), ("batch", "seq", "heads", "head_dim"))
+        k = cst(apply_rope(k, cos, sin),
+                ("batch", "seq", "kv_heads", "head_dim"))
+        if sp_manual:
+            o = _attention_sp_manual(q, k, v, cfg)
+        else:
+            o = _attention(q, k, v, cfg, mesh)
+        o = o.reshape(b, s, cfg.qkv_dim)
+        x = x + cst(o @ lp["wo"].astype(cfg.dtype), ("batch", "seq", "embed"))
+
+        h = rms_norm(x, lp["mlp_norm"])
+        if cfg.num_experts:
+            flat = h.reshape(b * s, cfg.embed_dim)
+            moe = moe_ffn(flat, lp["router"], lp["w_gate"], lp["w_up"],
+                          lp["w_down"], num_selected=cfg.num_selected,
+                          capacity_factor=cfg.capacity_factor,
+                          constrain=cst if mesh is not None else None)
+            ff = moe.out.reshape(b, s, cfg.embed_dim)
+            aux = aux + moe.aux_loss
+        else:
+            gate = h @ lp["w_gate"].astype(cfg.dtype)
+            up = h @ lp["w_up"].astype(cfg.dtype)
+            ff = swiglu(gate, up) @ lp["w_down"].astype(cfg.dtype)
+        x = x + cst(ff, ("batch", "seq", "embed"))
+        return (x, aux), None
+
+    return layer_fn
+
+
+def forward_pipelined(params: Dict[str, Any], tokens: jax.Array,
+                      cfg: LlamaConfig, *, mesh: Mesh,
+                      num_microbatches: int,
+                      rules: Optional[LogicalAxisRules] = None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Pipeline-parallel forward: transformer layers split into ``pp``
+    stages (parallel.pipeline), embed/head replicated across stages.
+
+    Sequence parallelism composes: with attn_impl ring/ulysses the pipeline
+    region is manual over {'pp','sp'} (jax/shardy cannot *nest* manual
+    regions) — activations enter seq-sharded, RoPE offsets come from the
+    'sp' rank, and attention runs inline over the bound axis.
+
+    MoE aux loss inside pipeline stages is dropped (stage outputs must be
+    activation-shaped); use dense FFN or accept coef=0 semantics under pp.
+    """
+    from ray_tpu.parallel.pipeline import pipeline_apply, split_stages
+    from ray_tpu.parallel.mesh import AXIS_PP
+
+    cst = _make_cst(mesh, rules)
+    b, s = tokens.shape
+    onehot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cfg.dtype)
+    x = cst(onehot @ params["embed"].astype(cfg.dtype),
+            ("batch", "seq", "embed"))
+
+    sp_manual = cfg.attn_impl in ("ring", "ulysses") and \
+        mesh.shape[AXIS_SP] > 1
+    if sp_manual:
+        # Inside the manual region 'seq' is device-local and 'sp' is bound:
+        # strip it from the rules GSPMD sees.
+        inner_rules = dict(rules if rules is not None else DEFAULT_RULES)
+        inner_rules["seq"] = None
+        x_spec = P(None, AXIS_SP, None)
+        manual_axes = {AXIS_PP, AXIS_SP}
+    else:
+        inner_rules = rules
+        x_spec = P()
+        manual_axes = {AXIS_PP}
+    layer_fn = _make_layer_fn(cfg, mesh, inner_rules, sp_manual=sp_manual)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def stage_fn(stage_params, x_mb):
+        (y, _), _ = jax.lax.scan(
+            layer_fn, (x_mb, jnp.zeros((), jnp.float32)), stage_params)
+        return y
+
+    stages = split_stages(params["layers"], mesh.shape[AXIS_PP])
+    x = pipeline_apply(stage_fn, stages, x, mesh=mesh,
+                       num_microbatches=num_microbatches,
+                       manual_axes=manual_axes, x_spec=x_spec)
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return cst(logits, ("batch", "seq", "vocab")), jnp.zeros((), jnp.float32)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            cfg: LlamaConfig, *, mesh: Optional[Mesh] = None,
+            rules: Optional[LogicalAxisRules] = None,
+            forward_fn=None) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Next-token cross-entropy.  batch: {"tokens": (b, s+1) int32} or
+    {"inputs": (b, s), "targets": (b, s)}; returns (loss, metrics).
+
+    ``forward_fn(params, inputs) -> (logits, aux)`` overrides the forward
+    pass (e.g. the pipelined path) so there is exactly one loss definition.
+    """
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    if forward_fn is None:
+        logits, aux = forward(params, inputs, cfg, mesh=mesh, rules=rules)
+    else:
+        logits, aux = forward_fn(params, inputs)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = jnp.mean(nll)
+    total = loss + cfg.aux_loss_coef * aux
+    return total, {"loss": loss, "aux_loss": aux,
+                   "perplexity": jnp.exp(loss)}

@@ -1,0 +1,325 @@
+"""Flash attention as a Pallas TPU kernel (fwd + bwd), with an XLA reference.
+
+Design (standard memory-efficient attention, mapped to the TPU grid model):
+
+- Forward: grid ``(batch, heads, q_blocks, kv_blocks)``.  The last grid
+  dimension is sequential on TPU, so softmax running stats ``(m, l)`` and the
+  output accumulator live in VMEM scratch that persists across kv iterations;
+  the normalized output and the logsumexp are written on the last kv block.
+- Backward: two kernels (the classic split): one accumulates ``dk, dv`` with
+  grid ``(b, h, kv_blocks, q_blocks)``, one accumulates ``dq`` with grid
+  ``(b, h, q_blocks, kv_blocks)``; both recompute ``p = exp(s - lse)`` from
+  the saved per-row logsumexp instead of materializing the S x S matrix.
+- Causal blocks that are fully masked are skipped with ``pl.when`` so the
+  kernel does ~half the FLOPs at long sequence.
+- Accumulation is f32 regardless of input dtype (bf16 inputs hit the MXU).
+
+Array layout is ``(batch, seq, heads, head_dim)`` (model-friendly); the grid
+iterates heads, so layout is handled by BlockSpec index maps, no transposes.
+
+The reference framework has no counterpart (Ray core has no tensor ops —
+SURVEY.md §5); this op is the compute leaf that the SP layer (ring/ulysses)
+and the model family build on.  On non-TPU backends the kernels run in
+pallas interpret mode, so the same code path is tested on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite "minus infinity": keeps exp() NaN-free on masked rows
+_LANES = 128     # TPU lane width; scratch stats are lane-replicated
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                  causal: bool = True, sm_scale: Optional[float] = None,
+                  q_offset: int = 0, kv_offset: int = 0) -> jax.Array:
+    """Pure-XLA multi-head attention, the numerics oracle for every kernel.
+
+    ``q_offset``/``kv_offset`` are global positions of element 0 of the q/kv
+    chunks — used by ring attention where each device holds a seq slice.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[1])[:, None]
+        ki = kv_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: block is live iff its last q row can see its first kv column.
+    live = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, :, 0, :]
+        k = k_ref[0, :, 0, :]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_scr[...]                          # (bq, LANES) replicated
+        m_cur = jnp.max(s, axis=-1, keepdims=True)   # (bq, 1)
+        m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])
+        l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), m_prev.shape)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_next
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, :, 0, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = m_scr[:, 0] + jnp.log(l[:, 0])
+
+
+def _fwd_call(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, d), lambda b_, h_, i, j: (b_, i, h_, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda b_, h_, i, j: (b_, j, h_, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda b_, h_, i, j: (b_, j, h_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, 1, d), lambda b_, h_, i, j: (b_, i, h_, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------- backward
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_scr, dv_scr,
+                 *, sm_scale, causal, block_q, block_k):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, :, 0, :]
+        k = k_ref[0, :, 0, :]
+        v = v_ref[0, :, 0, :]
+        do = do_ref[0, :, 0, :]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])                         # (bq, bk)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, :, 0, :] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, :, 0, :] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, :, 0, :]
+        k = k_ref[0, :, 0, :]
+        v = v_ref[0, :, 0, :]
+        do = do_ref[0, :, 0, :]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, :, 0, :] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+              interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    delta = jnp.einsum("bqhd,bqhd->bhq", o.astype(jnp.float32),
+                       do.astype(jnp.float32))
+
+    q_i = pl.BlockSpec((1, block_q, 1, d), lambda b_, h_, i, j: (b_, i, h_, 0))
+    q_j = pl.BlockSpec((1, block_q, 1, d), lambda b_, h_, i, j: (b_, j, h_, 0))
+    k_i = pl.BlockSpec((1, block_k, 1, d), lambda b_, h_, i, j: (b_, i, h_, 0))
+    k_j = pl.BlockSpec((1, block_k, 1, d), lambda b_, h_, i, j: (b_, j, h_, 0))
+    row_i = pl.BlockSpec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i))
+    row_j = pl.BlockSpec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, j))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q)),
+        in_specs=[q_j, k_i, k_i, q_j, row_j, row_j],
+        out_specs=[k_i, k_i],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
+        in_specs=[q_i, k_j, k_j, q_i, row_i, row_i],
+        out_specs=q_i,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------- public
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd_call(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd_call(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_call(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+                     interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Memory-efficient MHA.  q: (b, sq, h, d); k/v: (b, sk, h, d).
+
+    Supports grouped-query attention: if k/v have fewer heads than q and
+    ``h % h_kv == 0``, kv heads are repeated (XLA fuses the broadcast).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+    from ray_tpu.ops.layers import repeat_kv_heads
+    k, v = repeat_kv_heads(q, k, v)
+    # The kernels have no partial-block masking: blocks must tile the
+    # sequence exactly.  Shrink to a fitting power-of-two block; if none
+    # >= 8 exists, use the XLA reference (correct, O(S^2) memory).
+    block_q = _fit_block(block_q, q.shape[1])
+    block_k = _fit_block(block_k, k.shape[1])
+    if block_q is None or block_k is None:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+
+
+def _fit_block(block: int, seq: int) -> Optional[int]:
+    block = min(block, seq)
+    while block >= 8:
+        if seq % block == 0:
+            return block
+        block //= 2
+    return None

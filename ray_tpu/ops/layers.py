@@ -1,0 +1,60 @@
+"""Elementwise/normalization layer primitives (XLA-fused by design).
+
+These stay as plain jnp: XLA fuses them into neighboring matmuls, so a
+Pallas version would only add compile surface.  (Pallas is reserved for ops
+XLA can't schedule well: attention inner loops, ring collect-compute
+overlap — see ops/attention.py, ops/ring_attention.py.)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm (Llama-style, no mean subtraction).  Stats in f32."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope(seq_len: int, head_dim: int, theta: float = 10000.0,
+         offset=0) -> Tuple[jax.Array, jax.Array]:
+    """Rotary position embedding tables (cos, sin): (seq_len, head_dim/2).
+    ``offset`` may be traced (e.g. an 'sp' rank offset inside shard_map)."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                             / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32) + offset
+    angles = jnp.outer(t, freqs)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (b, s, h, d); cos/sin: (s, d/2).  Rotate-half formulation."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(gate: jax.Array, up: jax.Array) -> jax.Array:
+    """SwiGLU activation: silu(gate) * up."""
+    return jax.nn.silu(gate) * up
+
+
+def repeat_kv_heads(q: jax.Array, k: jax.Array, v: jax.Array):
+    """Broadcast GQA kv heads up to q's head count (validated)."""
+    h, h_kv = q.shape[2], k.shape[2]
+    if h == h_kv:
+        return k, v
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    rep = h // h_kv
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)

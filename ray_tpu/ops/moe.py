@@ -1,0 +1,95 @@
+"""Mixture-of-Experts routing (GShard/Switch-style) for expert parallelism.
+
+Token-choice top-k routing with fixed expert capacity, expressed as dense
+dispatch/combine einsums — the idiomatic XLA formulation: static shapes (no
+data-dependent gather), and when the expert dimension is sharded over the
+'ep' mesh axis the dispatch/combine contractions lower to all-to-alls over
+ICI.  The reference has no MoE; its expert-parallel analog would be NCCL
+all-to-all via ``ray.util.collective`` (SURVEY.md §2.3) — here the router is
+a framework op and the collective is XLA's.
+
+Returns auxiliary load-balancing loss (Switch §2.2 form: E * sum_e f_e * p_e).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.layers import swiglu
+
+
+class MoEOutput(NamedTuple):
+    out: jax.Array        # (tokens, embed)
+    aux_loss: jax.Array   # scalar load-balancing loss
+    router_probs: jax.Array  # (tokens, experts) — for metrics
+
+
+def route_topk(router_logits: jax.Array, num_selected: int,
+               capacity: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Compute (dispatch (T,E,C) f32 0/1, combine (T,E,C) f32, aux_loss).
+
+    Over-capacity tokens are dropped (their combine weights are zero), which
+    keeps shapes static — the XLA-native alternative to dynamic routing.
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, num_selected)   # (T, k)
+
+    # Position of each (token, choice) in its expert's buffer: running count
+    # of earlier assignments to the same expert, counted over the flattened
+    # (choice-major) assignment order so k=2 second choices queue after
+    # first choices.
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)      # (T, k, E)
+    flat = onehot.transpose(1, 0, 2).reshape(-1, e)              # (k*T, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat                   # (k*T, E)
+    pos = pos_flat.reshape(num_selected, t, e).transpose(1, 0, 2)  # (T,k,E)
+    pos = jnp.sum(pos * onehot, axis=-1)                         # (T, k)
+    within = pos < capacity
+
+    disp = jnp.zeros((t, e, capacity), jnp.float32)
+    comb = jnp.zeros((t, e, capacity), jnp.float32)
+    tok = jnp.arange(t)
+    for c in range(num_selected):
+        idx = (tok, expert_idx[:, c], jnp.clip(pos[:, c], 0, capacity - 1))
+        keep = within[:, c].astype(jnp.float32)
+        disp = disp.at[idx].add(keep)
+        comb = comb.at[idx].add(keep * gate_vals[:, c])
+
+    # Load-balance loss: fraction of tokens per expert x mean router prob.
+    density = jnp.mean(
+        jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(density * jnp.mean(probs, axis=0))
+    return disp, comb, aux
+
+
+def moe_ffn(x: jax.Array, router_w: jax.Array, w_gate: jax.Array,
+            w_up: jax.Array, w_down: jax.Array, *, num_selected: int = 2,
+            capacity_factor: float = 1.25,
+            constrain=None) -> MoEOutput:
+    """SwiGLU MoE layer.  x: (tokens, embed); router_w: (embed, E);
+    w_gate/w_up: (E, embed, mlp); w_down: (E, mlp, embed).
+
+    ``constrain(x, logical_axes)`` optionally applies sharding constraints
+    (expert tensors get ('expert', ...), so 'ep' carries the all-to-all).
+    """
+    t, d = x.shape
+    e = router_w.shape[1]
+    capacity = max(1, int(capacity_factor * t * num_selected / e))
+    logits = x @ router_w.astype(x.dtype)
+    disp, comb, aux = route_topk(logits, num_selected, capacity)
+
+    expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
+    if constrain is not None:
+        expert_in = constrain(expert_in, ("expert", None, "embed"))
+    gate = jnp.einsum("ecd,edm->ecm", expert_in, w_gate.astype(x.dtype))
+    up = jnp.einsum("ecd,edm->ecm", expert_in, w_up.astype(x.dtype))
+    act = swiglu(gate, up)
+    expert_out = jnp.einsum("ecm,emd->ecd", act, w_down.astype(x.dtype))
+    if constrain is not None:
+        expert_out = constrain(expert_out, ("expert", None, "embed"))
+    out = jnp.einsum("tec,ecd->td", comb.astype(x.dtype), expert_out)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return MoEOutput(out, aux, probs)

@@ -1,0 +1,65 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all head sharding.
+
+Alternative SP strategy to ring attention: instead of rotating K/V around a
+ring, two ``all_to_all``s re-shard the tensors from sequence-sharded to
+head-sharded, run *full* (flash) attention per head group, and shard back:
+
+    (b, s/n, h, d)  --all_to_all-->  (b, s, h/n, d)  --attn-->  --back-->
+
+Cost: 2 all-to-alls of activation size vs. ring's (n-1) K/V ppermutes;
+Ulysses wins when heads >= axis size and the interconnect does fast
+all-to-all (TPU ICI does); ring wins for very long sequence / few heads.
+Both are exposed so the Train layer can pick per model shape
+(SURVEY.md §5 — absent in the reference, first-class here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.layers import repeat_kv_heads
+from ray_tpu.parallel.mesh import AXIS_SP
+
+
+def _ulysses_sharded(q, k, v, sm_scale, causal, axis_name, use_flash):
+    # (b, s_local, h, d) -> (b, s_global, h_local, d)
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    q, k, v = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if use_flash:
+        o = flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    else:
+        o = mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return gather_heads(o)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      causal: bool = True, sm_scale: Optional[float] = None,
+                      mesh: Optional[Mesh] = None, axis_name: str = AXIS_SP,
+                      use_flash: bool = True) -> jax.Array:
+    """All-to-all sequence-parallel attention.  q/k/v: (b, seq, h, d) with
+    seq sharded over ``axis_name``; h must be divisible by the axis size."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    k, v = repeat_kv_heads(q, k, v)
+    if mesh is None:
+        return _ulysses_sharded(q, k, v, sm_scale, causal, axis_name,
+                                use_flash)
+    from ray_tpu.parallel.sharding import manual_shard_map
+    spec = P(None, axis_name, None, None)
+    fn = manual_shard_map(
+        lambda q_, k_, v_: _ulysses_sharded(q_, k_, v_, sm_scale, causal,
+                                            axis_name, use_flash),
+        {axis_name}, in_specs=(spec, spec, spec), out_specs=spec, mesh=mesh)
+    return fn(q, k, v)

@@ -1,0 +1,43 @@
+"""ray_tpu.parallel — parallelism strategies as first-class mesh axes.
+
+The reference delegates multi-device parallelism to out-of-band libraries
+(torch.distributed inside Train workers, ``python/ray/train/torch/config.py:113``;
+NCCL/Gloo groups in ``python/ray/util/collective/``; JAX model parallelism only
+via the Alpa release tests, ``release/alpa_tests/``).  On TPU, parallelism is a
+property of the *compiled program*: a ``jax.sharding.Mesh`` over ICI/DCN plus
+partition specs, with XLA inserting the collectives.  This package makes that
+the framework's first-class layer:
+
+- :mod:`mesh`       — mesh axes (dp, fsdp, ep, pp, sp, tp) and construction.
+- :mod:`sharding`   — logical-axis rules -> ``NamedSharding``/``PartitionSpec``.
+- :mod:`pipeline`   — GPipe-style pipeline parallelism via shard_map+ppermute.
+(``ray.util.collective``-equivalent host-level API lives in
+``ray_tpu.util.collective``; in-mesh collectives are ``jax.lax.p*``.)
+"""
+
+from ray_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    MESH_AXES,
+    MeshConfig,
+    make_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    LogicalAxisRules,
+    DEFAULT_RULES,
+    logical_to_mesh_axes,
+    named_sharding,
+    shard_pytree,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "AXIS_DP", "AXIS_FSDP", "AXIS_EP", "AXIS_PP", "AXIS_SP", "AXIS_TP",
+    "MESH_AXES", "MeshConfig", "make_mesh",
+    "LogicalAxisRules", "DEFAULT_RULES", "logical_to_mesh_axes",
+    "named_sharding", "shard_pytree", "with_logical_constraint",
+]

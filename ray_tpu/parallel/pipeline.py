@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe schedule over the 'pp' mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §5 — Alpa provided
+inter-op parallelism *on top of* Ray in release tests only).  Here it is a
+framework primitive: transformer layers are split into ``pp`` contiguous
+stages; each device in the 'pp' axis holds one stage's weights; microbatches
+flow through the ring with ``lax.ppermute`` carrying activations stage to
+stage over ICI.
+
+Implementation: ``jax.shard_map`` manual *only over 'pp'* (``axis_names``),
+so dp/fsdp/tp/sp/ep stay under GSPMD propagation inside the stage body —
+pipeline composes with the other strategies instead of forcing a full
+manual rewrite.  The schedule is plain GPipe (fill/drain bubble of
+``pp - 1`` steps; acceptable at microbatches >> pp, 1F1B is a later
+optimization).  The loop is ``lax.scan`` + ``ppermute`` + ``lax.cond`` —
+all reverse-differentiable, so the pipelined backward (reverse ppermutes)
+falls out of AD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import AXIS_PP
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x: jax.Array, *,
+                   mesh: Mesh, num_microbatches: int,
+                   axis_name: str = AXIS_PP,
+                   manual_axes: Optional[set] = None,
+                   x_spec: P = P()) -> jax.Array:
+    """Run ``x`` through ``pp`` stages of ``stage_fn``.
+
+    stage_params: pytree whose leaves have leading dim ``pp`` (stage-stacked)
+    — sharded over 'pp' by the caller or re-sharded here via in_specs.
+    x: (batch, ...) activations; batch must divide by ``num_microbatches``.
+    stage_fn(params_for_stage, x_mb) -> y_mb with identical shape.
+
+    ``manual_axes``/``x_spec``: extra axes to bind manually in the same
+    region (e.g. 'sp' with a seq-sharded ``x_spec`` for ring attention
+    inside pipeline stages — manual regions cannot nest).
+    """
+    manual_axes = manual_axes or {axis_name}
+    n = mesh.shape[axis_name]
+    if n == 1 and manual_axes == {axis_name}:
+        return stage_fn(jax.tree.map(lambda p: p[0], stage_params), x)
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} % microbatches {num_microbatches} != 0")
+    mb = b // num_microbatches
+    x_mb = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    def body(params, xs):
+        # shard_map hands each pp rank its stage slice with a leading
+        # singleton stage dim — strip it.
+        params = jax.tree.map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis_name)
+        steps = num_microbatches + n - 1
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(buf, t):
+            take = jnp.clip(t, 0, num_microbatches - 1)
+            fresh = jax.lax.dynamic_index_in_dim(xs, take, 0, keepdims=False)
+            inp = jnp.where(idx == 0, fresh, buf)
+            out = stage_fn(params, inp)
+            return jax.lax.ppermute(out, axis_name, perm), out
+
+        _, outs = jax.lax.scan(step, jnp.zeros_like(xs[0]),
+                               jnp.arange(steps))
+        # Last stage produced the real outputs at steps n-1 .. n-1+M-1;
+        # broadcast them to every pp rank (masked psum) so downstream
+        # (final norm / loss) is replicated over 'pp'.
+        outs = jax.lax.dynamic_slice_in_dim(outs, n - 1, num_microbatches, 0)
+        mask = (idx == n - 1).astype(outs.dtype)
+        return jax.lax.psum(outs * mask, axis_name)
+
+    from ray_tpu.parallel.sharding import manual_shard_map
+    specs_p = jax.tree.map(lambda _: P(axis_name), stage_params)
+    mb_spec = P(None, *x_spec)   # microbatch dim prepended
+    y_mb = manual_shard_map(
+        body, manual_axes, in_specs=(specs_p, mb_spec), out_specs=mb_spec,
+        mesh=mesh,
+    )(stage_params, x_mb)
+    return y_mb.reshape(x.shape)
+
+
+def split_stages(layer_params: Any, num_stages: int) -> Any:
+    """Reshape stacked-layer params (L, ...) -> (pp, L/pp, ...)."""
+    def rs(p):
+        l = p.shape[0]
+        if l % num_stages:
+            raise ValueError(f"{l} layers not divisible by {num_stages} stages")
+        return p.reshape((num_stages, l // num_stages) + p.shape[1:])
+    return jax.tree.map(rs, layer_params)

@@ -1,0 +1,17 @@
+"""ray_tpu.train — distributed training orchestration (Ray Train equivalent).
+
+Reference: ``python/ray/train/`` (SURVEY.md §2.3) — BaseTrainer/
+DataParallelTrainer/BackendExecutor/WorkerGroup, with per-framework collective
+backends (``train/torch/config.py:148`` starts NCCL process groups).  The TPU
+build replaces that seam with JAX: the "backend" is a mesh + sharded
+train step; gradient traffic is XLA collectives over ICI, never an external
+library.
+"""
+
+from ray_tpu.train.core import (
+    TrainState,
+    init_train_state,
+    make_train_step,
+)
+
+__all__ = ["TrainState", "init_train_state", "make_train_step"]

@@ -11,14 +11,28 @@ sharding logic without TPU hardware.
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax backend init anywhere in the test process.  The
+# image's sitecustomize registers a real-TPU 'axon' backend at interpreter
+# start, so the CPU override must additionally go through jax.config (env
+# vars alone are read before conftest runs).
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8"
 ).strip()
 # Tests never own the real TPU tunnel.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import sys
+
+if "jax" in sys.modules:
+    # sitecustomize (axon TPU tunnel) already imported jax and snapshotted
+    # JAX_PLATFORMS=axon — override through config.  Otherwise the env var
+    # above suffices and we skip paying the jax import for runtime-only
+    # test files.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

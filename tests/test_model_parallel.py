@@ -1,0 +1,111 @@
+"""Model-level parallelism tests: every mesh strategy must reproduce the
+single-device numerics (the reference tests multi-node semantics with an
+in-process Cluster, SURVEY.md §4.2; here the analog is the virtual 8-device
+CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import (
+    LlamaConfig, init_params, forward, loss_fn, param_logical_axes,
+)
+from ray_tpu.models.llama import forward_pipelined
+from ray_tpu.parallel import MeshConfig, make_mesh, shard_pytree
+from ray_tpu.train import TrainState, init_train_state, make_train_step
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _batch(cfg, b=4, s=32):
+    toks = jax.random.randint(KEY, (b, s + 1), 0, cfg.vocab_size,
+                              dtype=jnp.int32)
+    return {"tokens": toks}
+
+
+@pytest.mark.parametrize("name,cfg_kw,mesh_kw", [
+    ("dp_fsdp_tp", {}, dict(dp=2, fsdp=2, tp=2)),
+    ("flash_shmap", {"attn_impl": "flash"}, dict(dp=4, tp=2)),
+    ("moe_ring_sp", {"num_experts": 4, "attn_impl": "ring"},
+     dict(dp=2, sp=2, ep=2)),
+    ("moe_ulysses", {"num_experts": 4, "attn_impl": "ulysses"},
+     dict(sp=4, ep=2)),
+])
+def test_sharded_loss_matches_single_device(name, cfg_kw, mesh_kw):
+    cfg = LlamaConfig.tiny(**cfg_kw)
+    params = init_params(KEY, cfg)
+    batch = _batch(cfg)
+    ref, _ = loss_fn(params, batch, cfg)
+    mesh = make_mesh(MeshConfig(**mesh_kw))
+    with jax.set_mesh(mesh):
+        sp = shard_pytree(params, param_logical_axes(cfg), mesh)
+        toks = jax.device_put(
+            batch["tokens"], NamedSharding(mesh, P(("dp", "fsdp"), None)))
+        got, _ = jax.jit(
+            lambda p, t: loss_fn(p, {"tokens": t}, cfg, mesh=mesh))(sp, toks)
+    assert abs(float(got) - float(ref)) < 1e-4, name
+
+
+@pytest.mark.parametrize("attn", ["reference", "ring"])
+def test_pipelined_forward_matches(attn):
+    cfg = LlamaConfig.tiny(num_layers=4, attn_impl=attn)
+    params = init_params(KEY, cfg)
+    toks = jax.random.randint(KEY, (8, 32), 0, cfg.vocab_size,
+                              dtype=jnp.int32)
+    ref_logits, _ = forward(params, toks, cfg)
+    mesh = make_mesh(MeshConfig(dp=2, pp=2, sp=2 if attn == "ring" else 1,
+                                tp=1 if attn == "ring" else 2))
+    with jax.set_mesh(mesh):
+        sp = shard_pytree(params, param_logical_axes(cfg), mesh)
+        ts = jax.device_put(toks, NamedSharding(mesh, P(("dp", "fsdp"),
+                                                        None)))
+        got, _ = jax.jit(lambda p, t: forward_pipelined(
+            p, t, cfg, mesh=mesh, num_microbatches=4))(sp, ts)
+    assert jnp.max(jnp.abs(got - ref_logits)) < 5e-4
+
+
+def test_train_step_decreases_loss_single_device():
+    cfg = LlamaConfig.tiny()
+    opt = optax.adam(1e-2)
+    state = init_train_state(KEY, cfg, opt)
+    step = make_train_step(cfg, opt)
+    batch = _batch(cfg)
+    state, m0 = step(state, batch)   # step donates its input state
+    for _ in range(10):
+        state, metrics = step(state, batch)
+    assert float(metrics["loss"]) < float(m0["loss"])
+
+
+def test_train_step_sharded_matches_single_device():
+    cfg = LlamaConfig.tiny()
+    opt = optax.adam(1e-2)
+    batch = _batch(cfg, b=8)
+
+    state = init_train_state(KEY, cfg, opt)
+    step = make_train_step(cfg, opt, donate=False)
+    s1, m1 = step(state, batch)
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    with jax.set_mesh(mesh):
+        state_sh = init_train_state(KEY, cfg, opt, mesh=mesh)
+        step_sh = make_train_step(cfg, opt, mesh=mesh, donate=False)
+        toks = jax.device_put(
+            batch["tokens"], NamedSharding(mesh, P(("dp", "fsdp"), None)))
+        s2, m2 = step_sh(state_sh, {"tokens": toks})
+        assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-4
+        # params after one step agree
+        d = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                         s1.params, s2.params)
+    assert max(jax.tree.leaves(d)) < 1e-4
+
+
+def test_graft_entry_dryrun():
+    import sys, pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+    fn, args = g.entry()
+    jax.eval_shape(fn, *args)  # traceability; full compile covered by driver
