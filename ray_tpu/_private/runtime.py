@@ -273,6 +273,7 @@ class Runtime:
         self.functions: Dict[str, bytes] = {}
         self.worker_funcs: Dict[int, set] = {}  # conn fileno -> func_ids sent
         self.task_events: deque = deque(maxlen=10000)
+        self.events: Dict[str, deque] = {}  # topic -> payload bytes
         self._conn_to_worker: Dict[Any, WorkerHandle] = {}
         self._pending_workers: Dict[str, WorkerHandle] = {}
         self._io_wakeup_r, self._io_wakeup_w = multiprocessing.Pipe(False)
@@ -798,7 +799,16 @@ class Runtime:
             env.pop("TPU_VISIBLE_CHIPS", None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # Workers must import what the driver can: cloudpickle serializes
+        # module-level functions by reference, so the driver's sys.path
+        # (minus interpreter-internal entries) rides along (the reference's
+        # workers likewise inherit the job's environment/working dir).
+        import sys as _sys
+        extra = [p for p in _sys.path
+                 if p and p not in (pkg_root,) and os.path.isdir(p)]
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + extra + ([env["PYTHONPATH"]]
+                                  if env.get("PYTHONPATH") else []))
         env.update({
             "RAY_TPU_WORKER_ID": worker_id.hex(),
             "RAY_TPU_ADDRESS": self._listener.address,
@@ -1193,6 +1203,12 @@ class Runtime:
         tag = msg[0]
         if tag == "ready":
             worker.ready.set()
+        elif tag == "event":
+            # Generic worker->driver pubsub (reference: src/ray/pubsub/
+            # long-poll channels) — used by train session streaming.
+            with self.lock:
+                self.events.setdefault(msg[1], deque(maxlen=10000)).append(
+                    msg[2])
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
         elif tag == "get":
@@ -1623,6 +1639,16 @@ class Runtime:
                     if k.startswith(prefix)]
 
     # ------------------------------------------------------------ cancel --
+    def poll_events(self, topic: str) -> list:
+        """Drain pubsub payloads for a topic (driver side)."""
+        with self.lock:
+            q = self.events.get(topic)
+            if not q:
+                return []
+            out = list(q)
+            q.clear()
+            return out
+
     def cancel_task(self, object_id: ObjectID, force=False):
         with self.lock:
             st = self.objects.get(object_id)

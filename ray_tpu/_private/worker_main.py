@@ -188,6 +188,10 @@ class _WorkerRuntime:
         except Exception:
             return exc.RayTpuError("unknown error from driver")
 
+    def publish_event(self, topic: str, payload: bytes):
+        """Fire-and-forget pubsub to the driver (train session streaming)."""
+        self._send(("event", topic, payload))
+
     def put_object(self, value) -> ObjectRef:
         oid = ObjectID.for_put()
         self.begin_ref_collection()

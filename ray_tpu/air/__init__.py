@@ -1,0 +1,21 @@
+"""ray_tpu.air — shared trainer/tuner plumbing (Ray AIR equivalent).
+
+Reference: ``python/ray/air/`` (SURVEY.md §2.3) — config dataclasses
+(``config.py:80,508,567,695``), the morphing Checkpoint (``checkpoint.py:63``),
+and ``session.report`` (``session.py:43``).  TPU-first difference: a
+ScalingConfig describes a device *mesh shape* (MeshConfig), not just a worker
+count + use_gpu flag.
+"""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+
+__all__ = ["ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+           "Checkpoint", "Result", "session"]

@@ -1,0 +1,34 @@
+"""ray_tpu.data — distributed data loading & transform (Ray Data equivalent).
+
+Reference: ``python/ray/data/`` (SURVEY.md §2.3, 35k LoC) — Dataset over
+Arrow blocks living in the object store, lazy ExecutionPlan, bulk + streaming
+executors, datasource plugins, split() feeding Train shards.
+
+Condensation here: blocks are object-store refs holding lists-of-rows or
+dict-of-numpy "tensor blocks"; the plan is a lazy op chain executed by a
+bulk executor (one task per block per op — streaming executor is a later
+round); IO goes through pyarrow (parquet/csv/json).  The Train integration
+contract is the same: ``ds.split(k)`` -> per-worker shards,
+``shard.iter_batches()`` inside the train loop.
+"""
+
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range as range_,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+# `range` shadows the builtin inside this namespace on purpose — the
+# reference exposes ray.data.range the same way.
+range = range_
+
+__all__ = [
+    "Dataset", "from_items", "from_numpy", "from_pandas", "range",
+    "read_csv", "read_json", "read_parquet", "read_text",
+]

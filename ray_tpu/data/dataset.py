@@ -1,0 +1,388 @@
+"""Dataset: lazy op-chain over object-store blocks.
+
+Reference: ``python/ray/data/dataset.py:166`` (4.5k LoC Dataset),
+``_internal/plan.py`` (ExecutionPlan), ``_internal/execution/bulk_executor
+.py:20``.  Execution model kept: a Dataset is (block refs, lazy ops); ops
+are applied block-parallel as tasks at materialization; consumed via
+iter_rows/iter_batches/take/write_* or split() into Train shards.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu as ray
+
+
+# --------------------------------------------------------------- block ops
+# A block is a list of rows (dicts or scalars) or a dict-of-numpy arrays
+# ("tensor block").  Ops below run inside tasks (block-parallel).
+
+def _block_len(block) -> int:
+    if isinstance(block, dict):
+        for v in block.values():
+            return len(v)
+        return 0
+    return len(block)
+
+
+def _block_rows(block) -> Iterator[Any]:
+    if isinstance(block, dict):
+        keys = list(block)
+        for i in builtins.range(_block_len(block)):
+            yield {k: block[k][i] for k in keys}
+    else:
+        yield from block
+
+
+def _rows_to_block(rows: List[Any]):
+    return rows
+
+
+@ray.remote
+def _map_block(fn, block):
+    return _rows_to_block([fn(r) for r in _block_rows(block)])
+
+
+@ray.remote
+def _filter_block(fn, block):
+    return _rows_to_block([r for r in _block_rows(block) if fn(r)])
+
+
+@ray.remote
+def _flat_map_block(fn, block):
+    out = []
+    for r in _block_rows(block):
+        out.extend(fn(r))
+    return _rows_to_block(out)
+
+
+@ray.remote
+def _map_batches_block(fn, block, batch_format):
+    rows = list(_block_rows(block))
+    if batch_format == "numpy":
+        if rows and isinstance(rows[0], dict):
+            batch = {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+        else:
+            batch = np.asarray(rows)
+    elif batch_format == "pandas":
+        import pandas as pd
+        batch = pd.DataFrame(rows)
+    else:
+        batch = rows
+    out = fn(batch)
+    if isinstance(out, dict):
+        return out
+    try:
+        import pandas as pd
+        if isinstance(out, pd.DataFrame):
+            return out.to_dict("records")
+    except ImportError:
+        pass
+    if isinstance(out, np.ndarray):
+        return list(out)
+    return list(out)
+
+
+@ray.remote
+def _sort_block(block, key, descending):
+    rows = list(_block_rows(block))
+    keyfn = (lambda r: r[key]) if isinstance(key, str) else key
+    return sorted(rows, key=keyfn, reverse=descending)
+
+
+@ray.remote
+def _merge_sorted(key, descending, *blocks):
+    import heapq
+    keyfn = (lambda r: r[key]) if isinstance(key, str) else (key or (lambda r: r))
+    rows = list(heapq.merge(*blocks, key=keyfn, reverse=descending))
+    return rows
+
+
+@ray.remote
+def _shuffle_map(block, num_reducers, seed):
+    rng = np.random.default_rng(seed)
+    rows = list(_block_rows(block))
+    assignment = rng.integers(0, num_reducers, size=len(rows))
+    return [[r for r, a in zip(rows, assignment) if a == i]
+            for i in builtins.range(num_reducers)]
+
+
+@ray.remote
+def _shuffle_reduce(seed, *parts):
+    rows = list(itertools.chain(*parts))
+    rng = np.random.default_rng(seed)
+    rng.shuffle(rows)
+    return rows
+
+
+class Dataset:
+    """Immutable, lazily-transformed distributed collection."""
+
+    def __init__(self, block_refs: List[Any]):
+        self._blocks = list(block_refs)
+
+    # ------------------------------------------------------------ transforms
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return Dataset([_map_block.remote(fn, b) for b in self._blocks])
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return Dataset([_filter_block.remote(fn, b) for b in self._blocks])
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        return Dataset([_flat_map_block.remote(fn, b) for b in self._blocks])
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy"
+                    ) -> "Dataset":
+        return Dataset([_map_batches_block.remote(fn, b, batch_format)
+                        for b in self._blocks])
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self.take_all()
+        return from_items(rows, parallelism=num_blocks)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Push-based two-stage shuffle (reference:
+        _internal/push_based_shuffle.py): map tasks partition rows to
+        reducers; reduce tasks concat + locally shuffle."""
+        n = len(self._blocks)
+        if n == 0:
+            return self
+        seed = 0 if seed is None else seed
+        parts = [_shuffle_map.options(num_returns=n).remote(b, n, seed + i)
+                 for i, b in enumerate(self._blocks)]
+        if n == 1:
+            parts = [[p] for p in parts]
+        reducers = []
+        for j in builtins.range(n):
+            reducers.append(_shuffle_reduce.remote(
+                seed + 1000 + j, *[parts[i][j] for i in builtins.range(n)]))
+        return Dataset(reducers)
+
+    def sort(self, key: Union[str, Callable, None] = None,
+             descending: bool = False) -> "Dataset":
+        sorted_blocks = [_sort_block.remote(b, key, descending)
+                         for b in self._blocks]
+        merged = _merge_sorted.remote(key, descending, *sorted_blocks)
+        return Dataset([merged])
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = list(self._blocks)
+        for o in others:
+            blocks.extend(o._blocks)
+        return Dataset(blocks)
+
+    def limit(self, n: int) -> "Dataset":
+        rows = []
+        for b in self._blocks:
+            rows.extend(_block_rows(ray.get(b)))
+            if len(rows) >= n:
+                break
+        return from_items(rows[:n], parallelism=1)
+
+    def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
+        """Shard for Train workers (reference: dataset.py split + Train
+        dataset_spec.py)."""
+        rows = self.take_all()
+        if equal:
+            per = len(rows) // n
+            return [from_items(rows[i * per:(i + 1) * per], parallelism=1)
+                    for i in builtins.range(n)]
+        sizes = [len(rows) // n + (1 if i < len(rows) % n else 0)
+                 for i in builtins.range(n)]
+        out, cur = [], 0
+        for s in sizes:
+            out.append(from_items(rows[cur:cur + s], parallelism=1))
+            cur += s
+        return out
+
+    # ------------------------------------------------------------ consumers
+    def count(self) -> int:
+        @ray.remote
+        def _len(b):
+            return _block_len(b)
+        return sum(ray.get([_len.remote(b) for b in self._blocks]))
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for b in self._blocks:
+            out.extend(_block_rows(ray.get(b)))
+            if len(out) >= n:
+                return out[:n]
+        return out
+
+    def take_all(self) -> List[Any]:
+        out = []
+        for b in ray.get(list(self._blocks)):
+            out.extend(_block_rows(b))
+        return out
+
+    def iter_rows(self) -> Iterator[Any]:
+        for b in self._blocks:
+            yield from _block_rows(ray.get(b))
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        buf: List[Any] = []
+        for row in self.iter_rows():
+            buf.append(row)
+            if len(buf) == batch_size:
+                yield _format_batch(buf, batch_format)
+                buf = []
+        if buf and not drop_last:
+            yield _format_batch(buf, batch_format)
+
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    def schema(self):
+        rows = self.take(1)
+        if not rows:
+            return None
+        r = rows[0]
+        if isinstance(r, dict):
+            return {k: type(v).__name__ for k, v in r.items()}
+        return type(r).__name__
+
+    def sum(self, key: Optional[str] = None):
+        vals = (r[key] if key else r for r in self.iter_rows())
+        return sum(vals)
+
+    def mean(self, key: Optional[str] = None):
+        total, n = 0.0, 0
+        for r in self.iter_rows():
+            total += (r[key] if key else r)
+            n += 1
+        return total / max(n, 1)
+
+    # ------------------------------------------------------------------- IO
+    def write_parquet(self, path: str):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self._blocks):
+            rows = list(_block_rows(ray.get(b)))
+            if not rows:
+                continue
+            table = pa.Table.from_pylist(rows)
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str):
+        import pandas as pd
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self._blocks):
+            rows = list(_block_rows(ray.get(b)))
+            if rows:
+                pd.DataFrame(rows).to_csv(
+                    os.path.join(path, f"part-{i:05d}.csv"), index=False)
+
+    def write_json(self, path: str):
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, b in enumerate(self._blocks):
+            rows = list(_block_rows(ray.get(b)))
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+
+    def __repr__(self):
+        return f"Dataset(num_blocks={len(self._blocks)})"
+
+
+def _format_batch(rows: List[Any], batch_format: str):
+    if batch_format == "numpy":
+        if rows and isinstance(rows[0], dict):
+            return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+        return np.asarray(rows)
+    if batch_format == "pandas":
+        import pandas as pd
+        return pd.DataFrame(rows)
+    return rows
+
+
+# ------------------------------------------------------------ constructors
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    n = max(1, min(parallelism, len(items)) if items else 1)
+    per = (len(items) + n - 1) // n
+    blocks = [ray.put(items[i * per:(i + 1) * per])
+              for i in builtins.range(n)]
+    return Dataset(blocks)
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:
+    return from_items(list(builtins.range(n)), parallelism=parallelism)
+
+
+def from_numpy(arr: np.ndarray, *, parallelism: int = 8) -> Dataset:
+    return from_items(list(arr), parallelism=parallelism)
+
+
+def from_pandas(df, *, parallelism: int = 8) -> Dataset:
+    return from_items(df.to_dict("records"), parallelism=parallelism)
+
+
+def read_parquet(path: str, *, parallelism: int = 8) -> Dataset:
+    import glob
+    import os
+    files = sorted(glob.glob(os.path.join(path, "*.parquet"))) \
+        if os.path.isdir(path) else [path]
+
+    @ray.remote
+    def _load(f):
+        import pyarrow.parquet as pq
+        return pq.read_table(f).to_pylist()
+
+    return Dataset([_load.remote(f) for f in files])
+
+
+def read_csv(path: str, *, parallelism: int = 8) -> Dataset:
+    import glob
+    import os
+    files = sorted(glob.glob(os.path.join(path, "*.csv"))) \
+        if os.path.isdir(path) else [path]
+
+    @ray.remote
+    def _load(f):
+        import pandas as pd
+        return pd.read_csv(f).to_dict("records")
+
+    return Dataset([_load.remote(f) for f in files])
+
+
+def read_json(path: str, *, parallelism: int = 8) -> Dataset:
+    import glob
+    import os
+    files = sorted(glob.glob(os.path.join(path, "*.json"))) \
+        if os.path.isdir(path) else [path]
+
+    @ray.remote
+    def _load(f):
+        import json
+        with open(f) as fh:
+            return [json.loads(line) for line in fh if line.strip()]
+
+    return Dataset([_load.remote(f) for f in files])
+
+
+def read_text(path: str, *, parallelism: int = 8) -> Dataset:
+    import glob
+    import os
+    files = sorted(glob.glob(path)) if any(c in path for c in "*?") \
+        else ([os.path.join(path, f) for f in sorted(os.listdir(path))]
+              if os.path.isdir(path) else [path])
+
+    @ray.remote
+    def _load(f):
+        with open(f) as fh:
+            return [line.rstrip("\n") for line in fh]
+
+    return Dataset([_load.remote(f) for f in files])

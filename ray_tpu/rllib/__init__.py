@@ -1,0 +1,23 @@
+"""ray_tpu.rllib — RL training library (RLlib equivalent, second north-star).
+
+Reference: ``rllib/`` (SURVEY.md §2.4, 175k LoC).  The TPU build implements
+the *new Learner stack* the reference was migrating to (``rllib/core/learner``,
+SURVEY.md: "the TPU build should implement this stack rather than the legacy
+Policy-GPU path"): CPU rollout-worker actors feed a JAX Learner whose update
+is one jitted program on the TPU mesh.  Algorithms: PPO (sync on-policy) and
+IMPALA (async, V-trace in XLA) — the reference's two flagship algorithms.
+"""
+
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_batches
+from ray_tpu.rllib.models import ActorCriticMLP
+from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.impala import Impala, ImpalaConfig
+
+__all__ = [
+    "SampleBatch", "concat_batches", "ActorCriticMLP", "RolloutWorker",
+    "WorkerSet", "Learner", "LearnerGroup", "Algorithm", "AlgorithmConfig",
+    "PPO", "PPOConfig", "Impala", "ImpalaConfig",
+]

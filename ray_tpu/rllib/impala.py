@@ -1,0 +1,167 @@
+"""IMPALA: asynchronous off-policy actor-learner training.
+
+Reference: ``rllib/algorithms/impala/impala.py:474`` (``training_step``
+:616): workers sample asynchronously; batches flow to the learner without
+waiting for the fleet; staleness is corrected by V-trace.  The reference's
+CPU->GPU loader threads (``make_learner_thread`` :433,
+``multi_gpu_learner_thread.py``) have no equivalent here — one host->TPU
+``device_put`` per update and XLA's async dispatch already overlap transfer
+with compute.  Weights flow back per-worker on batch receipt (the
+broadcast-interval pattern of :571).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu as ray
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.models import ActorCriticMLP
+from ray_tpu.rllib.rollout_worker import WorkerSet
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, LOGP, NEXT_OBS, OBS, REWARDS, SampleBatch,
+)
+from ray_tpu.rllib.vtrace import vtrace
+
+
+def impala_loss(params, module, batch, *, gamma: float = 0.99,
+                vf_coef: float = 0.5, ent_coef: float = 0.01,
+                clip_rho: float = 1.0, clip_c: float = 1.0):
+    """batch arrays are (T, B, ...) time-major."""
+    t, b = batch[ACTIONS].shape
+    obs = batch[OBS].reshape(t * b, -1)
+    logits, values = module.apply(params, obs)
+    logits = logits.reshape(t, b, -1)
+    values = values.reshape(t, b)
+    logp_all = jax.nn.log_softmax(logits)
+    target_logp = jnp.take_along_axis(
+        logp_all, batch[ACTIONS][..., None].astype(jnp.int32), -1)[..., 0]
+    _, bootstrap = module.apply(params, batch["bootstrap_obs"])
+    discounts = gamma * (1.0 - batch[DONES].astype(jnp.float32))
+    vt = vtrace(batch[LOGP], target_logp, batch[REWARDS], values,
+                bootstrap, discounts, clip_rho, clip_c)
+    pi_loss = -jnp.mean(target_logp * vt.pg_advantages)
+    vf_loss = jnp.mean((values - vt.vs) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    loss = pi_loss + vf_coef * vf_loss - ent_coef * entropy
+    return loss, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                  "entropy": entropy}
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho_threshold = 1.0
+        self.clip_c_threshold = 1.0
+        self.grad_clip = 40.0
+        self.rollout_fragment_length = 50
+        self.max_batches_per_step = 8
+
+    @property
+    def algo_class(self):
+        return Impala
+
+
+class Impala(Algorithm):
+    config_class = ImpalaConfig
+
+    def _setup(self, cfg: ImpalaConfig):
+        env = cfg.env_maker()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        if hasattr(env, "close"):
+            env.close()
+        model_config = {"obs_dim": obs_dim, "num_actions": num_actions,
+                        "hidden": tuple(cfg.model.get("hidden", (64, 64)))}
+        self._obs_dim = obs_dim
+        self.workers = WorkerSet(
+            cfg.env_maker, model_config, cfg.num_rollout_workers,
+            cfg.num_envs_per_worker, gamma=cfg.gamma)
+        module = ActorCriticMLP(**model_config)
+
+        def loss(params, mod, batch):
+            return impala_loss(params, mod, batch, gamma=cfg.gamma,
+                               vf_coef=cfg.vf_loss_coeff,
+                               ent_coef=cfg.entropy_coeff,
+                               clip_rho=cfg.clip_rho_threshold,
+                               clip_c=cfg.clip_c_threshold)
+
+        self.learner_group = LearnerGroup(lambda: Learner(
+            module, loss, optimizer=optax.chain(
+                optax.clip_by_global_norm(cfg.grad_clip),
+                optax.adam(cfg.lr)), seed=cfg.seed))
+        w = self.learner_group.get_weights()
+        self.workers.sync_weights(w)
+        # Kick off the async pipeline: one outstanding sample per worker.
+        self._inflight = {
+            worker.sample.remote(cfg.rollout_fragment_length): i
+            for i, worker in enumerate(self.workers.workers)}
+
+    def _to_time_major(self, flat: SampleBatch, frag: int) -> Dict[str, Any]:
+        """Worker batches concatenate per-env fragments of length ``frag``;
+        reshape (n*frag, ...) -> (frag, n, ...) time-major."""
+        n = len(flat) // frag
+        out = {}
+        for k in (OBS, ACTIONS, REWARDS, DONES, LOGP):
+            v = flat[k][: n * frag]
+            out[k] = np.moveaxis(
+                v.reshape(n, frag, *v.shape[1:]), 0, 1)
+        next_obs = flat[NEXT_OBS][: n * frag].reshape(
+            n, frag, -1)
+        out["bootstrap_obs"] = next_obs[:, -1, :]
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: ImpalaConfig = self.algo_config
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        processed = 0
+        while processed < cfg.max_batches_per_step and self._inflight:
+            done, _ = ray.wait(list(self._inflight), num_returns=1,
+                               timeout=30.0)
+            if not done:
+                break
+            fut = done[0]
+            idx = self._inflight.pop(fut)
+            worker = self.workers.workers[idx]
+            try:
+                flat = ray.get(fut)
+            except Exception:
+                # Rebuild the dead worker before resubmitting — resubmitting
+                # to a dead handle busy-spins on instantly-errored futures.
+                worker = self.workers.recreate(idx)
+                worker.set_weights.remote(self.learner_group.get_weights())
+                self._inflight[worker.sample.remote(
+                    cfg.rollout_fragment_length)] = idx
+                continue
+            tm = self._to_time_major(flat, cfg.rollout_fragment_length)
+            metrics = self.learner_group.update(SampleBatch(tm))
+            steps += len(flat)
+            processed += 1
+            # per-worker weight refresh, then immediately resample (async)
+            worker.set_weights.remote(self.learner_group.get_weights())
+            self._inflight[worker.sample.remote(
+                cfg.rollout_fragment_length)] = idx
+        returns = self.workers.episode_returns()
+        if returns:
+            metrics["episode_reward_mean"] = float(np.mean(returns))
+        metrics["num_env_steps_sampled"] = steps
+        return metrics
+
+    def save_checkpoint(self):
+        return self.learner_group.state()
+
+    def load_checkpoint(self, state):
+        self.learner_group.load_state(state)
+        self.workers.sync_weights(self.learner_group.get_weights())
+
+    def cleanup(self):
+        self.workers.stop()

@@ -1,0 +1,134 @@
+"""Learner / LearnerGroup: the gradient-update half of the RL stack.
+
+Reference: ``rllib/core/learner/learner.py:89`` + ``learner_group.py:51`` —
+the in-progress "new Learner stack" that decouples updates from rollouts
+(SURVEY.md §2.4 says to build this, not the legacy Policy-GPU path).
+
+TPU design: one Learner owns the chips; its ``update(batch)`` is a single
+jitted program (loss -> grad -> optax).  Data parallelism over chips comes
+from sharding the batch over the mesh 'dp' axis — XLA inserts the gradient
+psum, the MultiGPULearnerThread/NCCL machinery of the reference
+(``rllib/execution/multi_gpu_learner_thread.py``) has no equivalent because
+the compiler owns the overlap.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class Learner:
+    """Holds params + optimizer; ``update`` jitted once."""
+
+    def __init__(self, module, loss_fn: Callable, *,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 seed: int = 0, mesh=None, batch_spec=None):
+        self.module = module
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer or optax.chain(
+            optax.clip_by_global_norm(0.5), optax.adam(3e-4))
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self._opt_state = self._optimizer.init(self.params)
+        self._mesh = mesh
+        self._batch_spec = batch_spec
+
+        def _update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, module, batch)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            metrics = dict(metrics, total_loss=loss,
+                           grad_norm=optax.global_norm(grads))
+            return params, opt_state, metrics
+
+        self._update = jax.jit(_update, donate_argnums=(0, 1))
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        if self._mesh is not None and self._batch_spec is not None:
+            from jax.sharding import NamedSharding
+            dev_batch = {
+                k: jax.device_put(v, NamedSharding(self._mesh,
+                                                   self._batch_spec))
+                for k, v in dev_batch.items()}
+        self.params, self._opt_state, metrics = self._update(
+            self.params, self._opt_state, dev_batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.device_put(weights)
+        self._opt_state = self._optimizer.init(self.params)
+
+    def state(self):
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self._opt_state)}
+
+    def load_state(self, state):
+        self.params = jax.device_put(state["params"])
+        self._opt_state = jax.device_put(state["opt_state"])
+
+
+class LearnerGroup:
+    """Reference: rllib/core/learner/learner_group.py:51.  v1 runs the
+    learner in-driver (the driver owns the TPU in single-host mode);
+    remote=True places it in a dedicated TPU actor."""
+
+    def __init__(self, learner_factory: Callable[[], Learner],
+                 remote: bool = False, num_tpus: int = 0):
+        self._remote = remote
+        if remote:
+            import ray_tpu as ray
+
+            @ray.remote
+            class _LearnerActor:
+                def __init__(self):
+                    self.learner = learner_factory()
+
+                def update(self, batch):
+                    return self.learner.update(batch)
+
+                def get_weights(self):
+                    return self.learner.get_weights()
+
+                def state(self):
+                    return self.learner.state()
+
+                def load_state(self, s):
+                    return self.learner.load_state(s)
+
+            self._actor = _LearnerActor.options(
+                num_tpus=num_tpus, num_cpus=1).remote()
+            self._ray = ray
+        else:
+            self._learner = learner_factory()
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        if self._remote:
+            return self._ray.get(self._actor.update.remote(batch))
+        return self._learner.update(batch)
+
+    def get_weights(self):
+        if self._remote:
+            return self._ray.get(self._actor.get_weights.remote())
+        return self._learner.get_weights()
+
+    def state(self):
+        if self._remote:
+            return self._ray.get(self._actor.state.remote())
+        return self._learner.state()
+
+    def load_state(self, s):
+        if self._remote:
+            return self._ray.get(self._actor.load_state.remote(s))
+        return self._learner.load_state(s)

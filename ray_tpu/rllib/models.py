@@ -1,0 +1,68 @@
+"""Policy networks in functional JAX.
+
+Reference: ``rllib/models/`` (ModelV2/ModelCatalog; the JAX support there is
+a 299-LoC stub, ``rllib/models/jax/``).  Here the model zoo is JAX-first:
+pure init/apply pairs over param pytrees, shardable with the same logical
+axis rules as the LLM family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ActorCriticMLP:
+    """Shared-nothing actor-critic MLP with categorical policy head."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        def mlp(key, sizes):
+            params = []
+            for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+                key, k = jax.random.split(key)
+                params.append({
+                    "w": jax.random.normal(k, (a, b)) * np.sqrt(2.0 / a),
+                    "b": jnp.zeros((b,)),
+                })
+            return params
+
+        k1, k2 = jax.random.split(key)
+        pi_sizes = (self.obs_dim,) + self.hidden + (self.num_actions,)
+        vf_sizes = (self.obs_dim,) + self.hidden + (1,)
+        return {"pi": mlp(k1, pi_sizes), "vf": mlp(k2, vf_sizes)}
+
+    @staticmethod
+    def _forward(layers, x):
+        for i, lyr in enumerate(layers):
+            x = x @ lyr["w"] + lyr["b"]
+            if i < len(layers) - 1:
+                x = jnp.tanh(x)
+        return x
+
+    def apply(self, params, obs) -> Tuple[jax.Array, jax.Array]:
+        """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
+        logits = self._forward(params["pi"], obs)
+        value = self._forward(params["vf"], obs)[..., 0]
+        return logits, value
+
+
+def sample_action(logits: np.ndarray,
+                  rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+    """Categorical sample + logp, numpy-side (rollout hot loop)."""
+    z = logits - logits.max(axis=-1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=-1, keepdims=True)
+    cum = np.cumsum(p, axis=-1)
+    r = rng.random(size=(len(p), 1))
+    acts = np.minimum((r > cum).sum(axis=-1), p.shape[-1] - 1)
+    logp = np.log(p[np.arange(len(p)), acts] + 1e-20)
+    return acts.astype(np.int32), logp.astype(np.float32)

@@ -1,0 +1,53 @@
+"""V-trace off-policy correction, as one XLA program.
+
+Reference: ``rllib/algorithms/impala/vtrace_torch.py`` (itself from the
+IMPALA paper, Espeholt et al. 2018).  The recurrence
+
+    vs_t = V(x_t) + delta_t + gamma * c_t * (vs_{t+1} - V(x_{t+1}))
+    delta_t = rho_t * (r_t + gamma * V(x_{t+1}) - V(x_t))
+
+is a backward ``lax.scan`` — sequential in T but batched over B on the
+VPU/MXU; no Python loops, fully differentiable (targets are
+stop_gradient'ed as in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class VTraceReturns(NamedTuple):
+    vs: jax.Array           # (T, B) value targets
+    pg_advantages: jax.Array  # (T, B) policy-gradient advantages
+
+
+def vtrace(behavior_logp: jax.Array, target_logp: jax.Array,
+           rewards: jax.Array, values: jax.Array,
+           bootstrap_value: jax.Array, discounts: jax.Array,
+           clip_rho_threshold: float = 1.0,
+           clip_c_threshold: float = 1.0) -> VTraceReturns:
+    """All inputs (T, B) time-major; bootstrap_value (B,);
+    discounts = gamma * (1 - done)."""
+    ratio = jnp.exp(target_logp - behavior_logp)
+    rho = jnp.minimum(clip_rho_threshold, ratio)
+    c = jnp.minimum(clip_c_threshold, ratio)
+    values_tp1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = rho * (rewards + discounts * values_tp1 - values)
+
+    def body(acc, xs):
+        delta_t, disc_t, c_t = xs
+        acc = delta_t + disc_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        body, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, c), reverse=True)
+    vs = values + vs_minus_v
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho * (rewards + discounts * vs_tp1 - values)
+    return VTraceReturns(vs=jax.lax.stop_gradient(vs),
+                         pg_advantages=jax.lax.stop_gradient(pg_adv))

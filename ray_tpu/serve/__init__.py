@@ -1,0 +1,27 @@
+"""ray_tpu.serve — model serving (Ray Serve equivalent).
+
+Reference: ``python/ray/serve/`` (SURVEY.md §2.3, 36k LoC) — control plane:
+``ServeController`` actor (controller.py:69) reconciling DeploymentState
+into replica actors; data plane: per-node HTTP proxies + handles routing to
+replicas (``_private/router.py:298``), rolling updates, autoscaling.
+
+Condensation: the controller is a real actor owning replica lifecycle and
+reconciliation (scale up/down, dead-replica replacement); handles
+round-robin over replicas; the HTTP proxy is an aiohttp server thread in
+the driver routing to handles.  TPU twist: a deployment created with
+``num_tpus=k`` gets TPU-resident replicas — the scheduler pins chips per
+replica actor, the Serve layer needs no device code.
+"""
+
+from ray_tpu.serve.api import (
+    Deployment,
+    DeploymentHandle,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+)
+
+__all__ = ["deployment", "Deployment", "DeploymentHandle", "run",
+           "get_deployment_handle", "shutdown", "start_http_proxy"]

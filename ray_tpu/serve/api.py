@@ -1,0 +1,311 @@
+"""Serve control + data plane.
+
+Reference call path (SURVEY.md §3.5): serve.run -> controller actor ->
+DeploymentState reconciliation -> replica actors; request path: proxy/handle
+-> router -> replica.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as ray
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ReplicaWrapper:
+    """Runs the user callable inside a replica actor process."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs):
+        if isinstance(cls_or_fn, type):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self._callable = cls_or_fn
+
+    def handle_request(self, args, kwargs):
+        fn = self._callable
+        if not callable(fn):
+            fn = fn.__call__
+        return fn(*args, **kwargs)
+
+    def call_method(self, method, args, kwargs):
+        return getattr(self._callable, method)(*args, **kwargs)
+
+    def health_check(self):
+        if hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True
+
+
+@ray.remote
+class ServeController:
+    """Reference: serve/controller.py:69 + _private/deployment_state.py:998
+    (DeploymentState reconciliation loop, here reconcile())."""
+
+    def __init__(self):
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._replicas: Dict[str, List[Any]] = {}
+
+    def deploy(self, name: str, payload: Dict[str, Any]):
+        """payload: cls_or_fn, init_args/kwargs, num_replicas, resources."""
+        self._deployments[name] = payload
+        self.reconcile()
+        return True
+
+    def delete_deployment(self, name: str):
+        self._deployments.pop(name, None)
+        for r in self._replicas.pop(name, []):
+            try:
+                ray.kill(r)
+            except Exception:
+                pass
+        return True
+
+    def _spawn(self, name: str):
+        d = self._deployments[name]
+        opts = {"num_cpus": d.get("num_cpus", 1)}
+        if d.get("num_tpus"):
+            opts["num_tpus"] = d["num_tpus"]
+        remote_cls = ray.remote(ReplicaWrapper)
+        return remote_cls.options(**opts).remote(
+            d["cls_or_fn"], d.get("init_args", ()),
+            d.get("init_kwargs", {}))
+
+    def reconcile(self):
+        """Drive actual replica sets toward target counts; replace dead
+        replicas (controller-driven health checks,
+        _private/deployment_state.py)."""
+        for name, d in self._deployments.items():
+            reps = self._replicas.setdefault(name, [])
+            alive = []
+            for r in reps:
+                try:
+                    ray.get(r.health_check.remote(), timeout=5)
+                    alive.append(r)
+                except Exception:
+                    pass
+            target = d.get("num_replicas", 1)
+            while len(alive) < target:
+                alive.append(self._spawn(name))
+            while len(alive) > target:
+                doomed = alive.pop()
+                try:
+                    ray.kill(doomed)
+                except Exception:
+                    pass
+            self._replicas[name] = alive
+        return {n: len(r) for n, r in self._replicas.items()}
+
+    def get_replicas(self, name: str):
+        return list(self._replicas.get(name, []))
+
+    def list_deployments(self):
+        return {n: {"num_replicas": d.get("num_replicas", 1)}
+                for n, d in self._deployments.items()}
+
+    def scale(self, name: str, num_replicas: int):
+        self._deployments[name]["num_replicas"] = num_replicas
+        self.reconcile()
+        return True
+
+
+class DeploymentHandle:
+    """Round-robin router over replicas (reference:
+    _private/router.py:262 ReplicaSet / handle API).
+
+    The replica set is re-fetched from the controller on a short TTL (the
+    reference pushes updates via LongPollClient, _private/long_poll.py:68 —
+    TTL polling is the condensation) so scaling and dead-replica
+    replacement propagate to existing handles.
+    """
+
+    _TTL = 2.0
+
+    def __init__(self, name: str, controller):
+        self._name = name
+        self._controller = controller
+        self._replicas: List[Any] = []
+        self._fetched_at = 0.0
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+        self._refresh()
+
+    def _refresh(self):
+        self._replicas = ray.get(
+            self._controller.get_replicas.remote(self._name))
+        self._fetched_at = time.monotonic()
+
+    def _pick(self):
+        with self._lock:
+            if not self._replicas or                     time.monotonic() - self._fetched_at > self._TTL:
+                self._refresh()
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self._name} has no replicas")
+            return self._replicas[next(self._rr) % len(self._replicas)]
+
+    def remote(self, *args, **kwargs):
+        return self._pick().handle_request.remote(args, kwargs)
+
+    def method(self, method_name: str):
+        handle = self
+
+        class _M:
+            def remote(self, *args, **kwargs):
+                return handle._pick().call_method.remote(
+                    method_name, args, kwargs)
+
+        return _M()
+
+
+class Deployment:
+    """Result of @serve.deployment — bind/deploy surface (reference:
+    serve/deployment.py)."""
+
+    def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
+                 num_cpus: float = 1, num_tpus: int = 0,
+                 route_prefix: Optional[str] = None):
+        self._cls_or_fn = cls_or_fn
+        self.name = name
+        self.num_replicas = num_replicas
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+        self.route_prefix = route_prefix or f"/{name}"
+        self._init_args = ()
+        self._init_kwargs = {}
+
+    def options(self, **kw) -> "Deployment":
+        d = Deployment(self._cls_or_fn, kw.get("name", self.name),
+                       kw.get("num_replicas", self.num_replicas),
+                       kw.get("num_cpus", self.num_cpus),
+                       kw.get("num_tpus", self.num_tpus),
+                       kw.get("route_prefix", self.route_prefix))
+        d._init_args = self._init_args
+        d._init_kwargs = self._init_kwargs
+        return d
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        d = self.options()
+        d._init_args = args
+        d._init_kwargs = kwargs
+        return d
+
+
+def deployment(cls_or_fn=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, num_cpus: float = 1,
+               num_tpus: int = 0, route_prefix: Optional[str] = None):
+    """@serve.deployment (reference: serve/api.py deployment)."""
+
+    def wrap(target):
+        return Deployment(target, name or target.__name__, num_replicas,
+                          num_cpus, num_tpus, route_prefix)
+
+    if cls_or_fn is not None:
+        return wrap(cls_or_fn)
+    return wrap
+
+
+_state: Dict[str, Any] = {"controller": None, "proxy": None,
+                          "handles": {}, "routes": {}}
+
+
+def _get_controller():
+    if _state["controller"] is None:
+        _state["controller"] = ServeController.options(
+            name=CONTROLLER_NAME).remote()
+    return _state["controller"]
+
+
+def run(target: Deployment, *, name: Optional[str] = None
+        ) -> DeploymentHandle:
+    """Deploy + return a handle (reference: serve.run, api.py:458)."""
+    controller = _get_controller()
+    dep_name = name or target.name
+    ray.get(controller.deploy.remote(dep_name, {
+        "cls_or_fn": target._cls_or_fn,
+        "init_args": target._init_args,
+        "init_kwargs": target._init_kwargs,
+        "num_replicas": target.num_replicas,
+        "num_cpus": target.num_cpus,
+        "num_tpus": target.num_tpus,
+    }))
+    handle = DeploymentHandle(dep_name, controller)
+    _state["handles"][dep_name] = handle
+    _state["routes"][target.route_prefix] = handle
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    h = _state["handles"].get(name)
+    if h is None:
+        h = DeploymentHandle(name, _get_controller())
+        _state["handles"][name] = h
+    return h
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 8000):
+    """HTTP ingress (reference: HTTPProxyActor, _private/http_proxy.py:415).
+    Runs an aiohttp server on a driver thread; routes by path prefix."""
+    import asyncio
+
+    from aiohttp import web
+
+    async def handle(request: web.Request):
+        path = "/" + request.path.strip("/").split("/")[0]
+        h = _state["routes"].get(path)
+        if h is None:
+            return web.json_response({"error": "no such route"}, status=404)
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        loop = asyncio.get_event_loop()
+        ref = h.remote(body)
+        result = await loop.run_in_executor(None, lambda: ray.get(ref))
+        return web.json_response({"result": result})
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handle)
+    runner = web.AppRunner(app)
+    ready = threading.Event()
+    state: Dict[str, Any] = {}
+
+    def serve_thread():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, host, port)
+        loop.run_until_complete(site.start())
+        state["loop"] = loop
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve_thread, daemon=True,
+                         name="serve-http-proxy")
+    t.start()
+    ready.wait(10)
+    _state["proxy"] = (t, runner, state)
+    return f"http://{host}:{port}"
+
+
+def shutdown():
+    if _state["controller"] is not None:
+        try:
+            for name in list(
+                    ray.get(_state["controller"].list_deployments.remote())):
+                ray.get(_state["controller"].delete_deployment.remote(name))
+            ray.kill(_state["controller"])
+        except Exception:
+            pass
+    proxy = _state.get("proxy")
+    if proxy:
+        try:
+            proxy[2]["loop"].call_soon_threadsafe(proxy[2]["loop"].stop)
+        except Exception:
+            pass
+    _state.update({"controller": None, "proxy": None, "handles": {},
+                   "routes": {}})

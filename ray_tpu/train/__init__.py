@@ -10,8 +10,21 @@ library.
 
 from ray_tpu.train.core import (
     TrainState,
+    default_optimizer,
     init_train_state,
     make_train_step,
 )
+from ray_tpu.train.backend import Backend, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+)
+from ray_tpu.train.worker_group import WorkerGroup
 
-__all__ = ["TrainState", "init_train_state", "make_train_step"]
+__all__ = [
+    "TrainState", "init_train_state", "make_train_step", "default_optimizer",
+    "Backend", "JaxConfig", "BackendExecutor", "TrainingFailedError",
+    "BaseTrainer", "DataParallelTrainer", "JaxTrainer", "WorkerGroup",
+]

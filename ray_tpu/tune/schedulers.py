@@ -1,0 +1,141 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Reference: ``python/ray/tune/schedulers/`` — FIFO (default), ASHA
+(``async_hyperband.py``), PBT (``pbt.py``).  Interface mirrors
+``TrialScheduler.on_trial_result -> CONTINUE | STOP`` plus PBT's
+exploit/explore via trial checkpoints.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_result(self, runner, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial, result: Dict[str, Any]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: schedulers/async_hyperband.py): successive-halving
+    rungs; a trial reaching a rung survives only if in the top 1/rf of
+    completed results at that rung."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4.0, brackets: int = 1):
+        self._metric = metric
+        self._mode = mode
+        self._max_t = max_t
+        self._grace = grace_period
+        self._rf = reduction_factor
+        # rung milestones: grace * rf^k below max_t
+        self._milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self._milestones.append(int(t))
+            t *= reduction_factor
+        self._rungs: Dict[int, List[float]] = {m: [] for m in self._milestones}
+
+    def _val(self, result):
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = result.get("training_iteration", 0)
+        v = self._val(result)
+        if v is None:
+            return CONTINUE
+        if t >= self._max_t:
+            return STOP
+        for m in self._milestones:
+            if t == m:
+                rung = self._rungs[m]
+                rung.append(v)
+                cutoff_idx = max(0, math.ceil(len(rung) / self._rf) - 1)
+                cutoff = sorted(rung, reverse=True)[cutoff_idx]
+                if v < cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: schedulers/pbt.py): at each perturbation interval,
+    bottom-quantile trials clone the checkpoint of a top-quantile trial
+    (exploit) and perturb its hyperparameters (explore)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self._metric = metric
+        self._mode = mode
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = {}
+
+    def _val(self, result):
+        v = float(result[self._metric])
+        return v if self._mode == "max" else -v
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = dict(config)
+        for key, mut in self._mutations.items():
+            if self._rng.random() < self._resample_prob or key not in new:
+                if callable(mut):
+                    new[key] = mut()
+                elif isinstance(mut, list):
+                    new[key] = self._rng.choice(mut)
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                if isinstance(mut, list):
+                    new[key] = self._rng.choice(mut)
+                else:
+                    new[key] = new[key] * factor
+        return new
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        if self._metric not in result:
+            return CONTINUE
+        t = result.get("training_iteration", 0)
+        self._last_scores[trial.trial_id] = self._val(result)
+        if t - self._last_perturb.get(trial.trial_id, 0) < self._interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        scores = self._last_scores
+        if len(scores) < 2:
+            return CONTINUE
+        ranked = sorted(scores, key=scores.get, reverse=True)
+        k = max(1, int(len(ranked) * self._quantile))
+        top, bottom = ranked[:k], ranked[-k:]
+        if trial.trial_id not in bottom or trial.trial_id in top:
+            return CONTINUE
+        donor_id = self._rng.choice(top)
+        donor = runner.get_trial(donor_id)
+        if donor is None or donor.latest_checkpoint is None:
+            return CONTINUE
+        # Exploit + explore: runner clones donor checkpoint into this trial
+        # with a mutated config (reference: pbt.py _exploit).
+        new_config = self._explore(donor.config)
+        runner.transfer_checkpoint(donor, trial, new_config)
+        return CONTINUE

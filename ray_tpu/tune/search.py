@@ -1,0 +1,131 @@
+"""Search spaces and trial generation.
+
+Reference: ``python/ray/tune/search/sample.py`` (Domain/Categorical/Float/
+grid_search) and ``search/basic_variant.py`` (BasicVariantGenerator: grid
+cross-product x num_samples random draws).  External searcher adapters
+(Optuna/HyperOpt/...) plug in via the same Searcher interface
+(``search/searcher.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Float(Domain):
+    def __init__(self, lower, upper, log=False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        import math
+        if self.log:
+            return math.exp(rng.uniform(math.log(self.lower),
+                                        math.log(self.upper)))
+        return rng.uniform(self.lower, self.upper)
+
+
+class Integer(Domain):
+    def __init__(self, lower, upper):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower, upper) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower, upper) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower, upper) -> Integer:
+    return Integer(lower, upper)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+class sample_from:
+    """Explicit marker for config values sampled by calling a function
+    (reference: tune.sample_from).  Bare callables in a param space are
+    passed through untouched — they are often legitimate values, e.g. an
+    env constructor."""
+
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+
+class Searcher:
+    """Pluggable suggestion interface (reference: search/searcher.py)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Dict[str, Any]):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product x num_samples random draws (reference:
+    search/basic_variant.py)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self._space = space
+        grid_keys = [k for k, v in space.items()
+                     if isinstance(v, GridSearch)]
+        grids = [space[k].values for k in grid_keys]
+        self._grid_points = [dict(zip(grid_keys, combo))
+                             for combo in itertools.product(*grids)] \
+            if grid_keys else [{}]
+        self._num_samples = num_samples
+        self._iter = self._generate()
+
+    def _generate(self) -> Iterator[Dict[str, Any]]:
+        for _ in range(self._num_samples):
+            for grid_point in self._grid_points:
+                cfg = {}
+                for k, v in self._space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = grid_point[k]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    elif isinstance(v, sample_from):
+                        cfg[k] = v.fn()
+                    else:
+                        cfg[k] = v
+                yield cfg
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            return next(self._iter)
+        except StopIteration:
+            return None

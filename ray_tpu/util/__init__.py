@@ -8,9 +8,11 @@ from ray_tpu.util.scheduling_strategies import (
     PlacementGroupSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
 )
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util import accelerators
 
 __all__ = [
     "placement_group", "remove_placement_group", "placement_group_table",
     "PlacementGroup", "PlacementGroupSchedulingStrategy",
-    "NodeAffinitySchedulingStrategy",
+    "NodeAffinitySchedulingStrategy", "ActorPool", "accelerators",
 ]

@@ -1,0 +1,81 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py) — load-balance a
+stream of work over a fixed set of actors.  ``map`` preserves input order
+(as the reference does); ``map_unordered`` yields in completion order."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List
+
+import ray_tpu as ray
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        if not actors:
+            raise ValueError("ActorPool needs at least one actor")
+        self._idle = list(actors)
+        self._future_to_meta = {}   # future -> (actor, submission index)
+        self._pending = []          # queued (fn, value, index)
+        self._next_idx = 0
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any):
+        """fn(actor, value) -> ObjectRef."""
+        idx = self._next_idx
+        self._next_idx += 1
+        if self._idle:
+            actor = self._idle.pop()
+            self._future_to_meta[fn(actor, value)] = (actor, idx)
+        else:
+            self._pending.append((fn, value, idx))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_meta) or bool(self._pending)
+
+    def _complete_one(self, timeout=None):
+        done, _ = ray.wait(list(self._future_to_meta), num_returns=1,
+                           timeout=timeout)
+        if not done:
+            raise TimeoutError("get_next timed out")
+        fut = done[0]
+        actor, idx = self._future_to_meta.pop(fut)
+        if self._pending:
+            fn, value, pidx = self._pending.pop(0)
+            self._future_to_meta[fn(actor, value)] = (actor, pidx)
+        else:
+            self._idle.append(actor)
+        return idx, ray.get(fut)
+
+    def get_next(self, timeout=None) -> Any:
+        """Next result in completion order."""
+        if not self.has_next():
+            raise StopIteration("no pending work")
+        return self._complete_one(timeout)[1]
+
+    def get_next_unordered(self, timeout=None) -> Any:
+        return self.get_next(timeout)
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        """Results in input order (reference semantics)."""
+        base = self._next_idx
+        for v in values:
+            self.submit(fn, v)
+        buffered = {}
+        want = base
+        while self.has_next() or buffered:
+            while want in buffered:
+                yield buffered.pop(want)
+                want += 1
+            if not self.has_next():
+                break
+            idx, result = self._complete_one()
+            buffered[idx] = result
+        while want in buffered:
+            yield buffered.pop(want)
+            want += 1
+
+    def map_unordered(self, fn, values) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()

@@ -1,0 +1,254 @@
+"""Host-level collectives between actors (ray.util.collective equivalent).
+
+Reference: ``python/ray/util/collective/collective.py`` —
+``init_collective_group`` (:120), declarative ``create_collective_group``
+(:151), ``allreduce/allgather/reducescatter/broadcast/send/recv``
+(:258,423,472,373,531,594) over NCCL/Gloo groups.
+
+TPU split (SURVEY.md §2.3): *device* collectives are XLA (``jax.lax.p*``
+under jit over the mesh — see ray_tpu.parallel), so this module only covers
+the *host* tier the reference used Gloo for: numpy buffers between actor
+processes, rendezvoused through a named coordinator actor (threaded, so
+blocking barriers work).  That is the DCN-control-plane analog — checkpoint
+shards, rollout aggregation, eval gathers; never the gradient hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu as ray
+
+_GROUP_PREFIX = "collective_group:"
+_local = threading.local()
+
+
+@ray.remote
+class _Coordinator:
+    """Rendezvous + reduction point for one group.  max_concurrency lets all
+    ranks block inside contribute() simultaneously."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._rounds: Dict[tuple, Dict[int, Any]] = {}
+        self._results: Dict[tuple, Any] = {}
+
+    def _gather(self, key, rank, value):
+        """Block until all ranks contributed; the completion flag is
+        monotonic (a waiter's predicate can never flip back to false while
+        another rank starts consuming the round)."""
+        with self._cond:
+            slot = self._rounds.setdefault(
+                key, {"vals": {}, "done": False, "left": self.world_size})
+            slot["vals"][rank] = value
+            if len(slot["vals"]) == self.world_size:
+                slot["done"] = True
+                self._cond.notify_all()
+            elif not self._cond.wait_for(lambda: slot["done"], timeout=120):
+                raise TimeoutError(
+                    f"collective round {key} timed out with "
+                    f"{len(slot['vals'])}/{self.world_size} ranks")
+            return slot
+
+    def _finish(self, key, slot, compute):
+        """First-finisher computes; everyone reads; last rank cleans up."""
+        with self._lock:
+            if key not in self._results:
+                self._results[key] = compute(slot["vals"])
+            out = self._results[key]
+            slot["left"] -= 1
+            if slot["left"] == 0:
+                self._rounds.pop(key, None)
+                self._results.pop(key, None)
+            return out
+
+    def allreduce(self, seq, rank, arr, op):
+        key = ("ar", seq)
+        slot = self._gather(key, rank, arr)
+
+        def compute(vals):
+            vs = [vals[r] for r in sorted(vals)]
+            if op == "sum":
+                return sum(vs[1:], start=vs[0].copy())
+            if op == "max":
+                return np.maximum.reduce(vs)
+            if op == "min":
+                return np.minimum.reduce(vs)
+            if op == "mean":
+                return sum(vs[1:], start=vs[0].copy()) / len(vs)
+            raise ValueError(op)
+
+        return self._finish(key, slot, compute)
+
+    def allgather(self, seq, rank, arr):
+        key = ("ag", seq)
+        slot = self._gather(key, rank, arr)
+        return self._finish(
+            key, slot, lambda vals: [vals[r] for r in sorted(vals)])
+
+    def reducescatter(self, seq, rank, arr, op):
+        key = ("rs", seq)
+        slot = self._gather(key, rank, arr)
+
+        def compute(vals):
+            vs = [vals[r] for r in sorted(vals)]
+            total = sum(vs[1:], start=vs[0].copy()) if op == "sum" \
+                else np.maximum.reduce(vs)
+            return np.array_split(total, self.world_size)
+
+        return self._finish(key, slot, compute)[rank]
+
+    def broadcast(self, seq, rank, arr, src):
+        key = ("bc", seq)
+        slot = self._gather(key, rank, arr if rank == src else None)
+        return self._finish(key, slot, lambda vals: vals[src])
+
+    def barrier(self, seq, rank):
+        key = ("ba", seq)
+        slot = self._gather(key, rank, True)
+        return self._finish(key, slot, lambda vals: True)
+
+    def put_p2p(self, seq, dst, arr):
+        with self._cond:
+            self._rounds[("p2p", seq, dst)] = {0: arr}
+            self._cond.notify_all()
+        return True
+
+    def get_p2p(self, seq, dst):
+        with self._cond:
+            self._cond.wait_for(
+                lambda: ("p2p", seq, dst) in self._rounds, timeout=120)
+            return self._rounds.pop(("p2p", seq, dst))[0]
+
+
+class _GroupState:
+    def __init__(self, name, rank, world_size, coordinator):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.coordinator = coordinator
+        self.seq = 0
+        # p2p counters are per (src, dst) pair: only the two endpoints
+        # advance them, so they stay matched without a global barrier.
+        self.p2p_seq: Dict[tuple, int] = {}
+
+    def next_seq(self):
+        self.seq += 1
+        return self.seq
+
+    def next_p2p_seq(self, src: int, dst: int):
+        key = (src, dst)
+        self.p2p_seq[key] = self.p2p_seq.get(key, 0) + 1
+        return self.p2p_seq[key]
+
+
+def _groups() -> Dict[str, _GroupState]:
+    if not hasattr(_local, "groups"):
+        _local.groups = {}
+    return _local.groups
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default"):
+    """Called by each participating actor/task (reference:
+    collective.py:120)."""
+    name = _GROUP_PREFIX + group_name
+    if rank == 0:
+        coord = _Coordinator.options(
+            name=name, max_concurrency=max(world_size + 2, 4),
+            num_cpus=0).remote(world_size)
+    else:
+        coord = _wait_for_actor(name)
+    _groups()[group_name] = _GroupState(group_name, rank, world_size, coord)
+
+
+def _wait_for_actor(name, timeout=30.0):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            return ray.get_actor(name)
+        except Exception:
+            time.sleep(0.05)
+    raise TimeoutError(f"collective group actor {name} not found")
+
+
+def create_collective_group(actors: List[Any], world_size: int,
+                            ranks: List[int],
+                            group_name: str = "default"):
+    """Declarative setup from the driver (reference: collective.py:151)."""
+    futs = []
+    for actor, rank in zip(actors, ranks):
+        futs.append(actor.execute.remote(
+            init_collective_group, world_size, rank, group_name))
+    ray.get(futs)
+
+
+def _group(group_name) -> _GroupState:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            f"process — call init_collective_group first")
+    return g
+
+
+def allreduce(tensor: np.ndarray, group_name: str = "default",
+              op: str = "sum") -> np.ndarray:
+    g = _group(group_name)
+    return ray.get(g.coordinator.allreduce.remote(
+        g.next_seq(), g.rank, np.asarray(tensor), op))
+
+
+def allgather(tensor: np.ndarray, group_name: str = "default"
+              ) -> List[np.ndarray]:
+    g = _group(group_name)
+    return ray.get(g.coordinator.allgather.remote(
+        g.next_seq(), g.rank, np.asarray(tensor)))
+
+
+def reducescatter(tensor: np.ndarray, group_name: str = "default",
+                  op: str = "sum") -> np.ndarray:
+    g = _group(group_name)
+    return ray.get(g.coordinator.reducescatter.remote(
+        g.next_seq(), g.rank, np.asarray(tensor), op))
+
+
+def broadcast(tensor: np.ndarray, src_rank: int = 0,
+              group_name: str = "default") -> np.ndarray:
+    g = _group(group_name)
+    return ray.get(g.coordinator.broadcast.remote(
+        g.next_seq(), g.rank, np.asarray(tensor), src_rank))
+
+
+def barrier(group_name: str = "default"):
+    g = _group(group_name)
+    ray.get(g.coordinator.barrier.remote(g.next_seq(), g.rank))
+
+
+def send(tensor: np.ndarray, dst_rank: int, group_name: str = "default"):
+    g = _group(group_name)
+    seq = g.next_p2p_seq(g.rank, dst_rank)
+    ray.get(g.coordinator.put_p2p.remote(
+        (g.rank, dst_rank, seq), dst_rank, np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default") -> np.ndarray:
+    g = _group(group_name)
+    seq = g.next_p2p_seq(src_rank, g.rank)
+    return ray.get(g.coordinator.get_p2p.remote(
+        (src_rank, g.rank, seq), g.rank))
+
+
+def destroy_collective_group(group_name: str = "default"):
+    g = _groups().pop(group_name, None)
+    if g is not None and g.rank == 0:
+        try:
+            ray.kill(g.coordinator)
+        except Exception:
+            pass

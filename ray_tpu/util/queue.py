@@ -1,0 +1,88 @@
+"""Distributed FIFO queue (reference: python/ray/util/queue.py) — an actor
+holding the buffer; blocking get/put via a threaded actor."""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, List, Optional
+
+import ray_tpu as ray
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._maxsize = maxsize
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+
+    def put(self, item, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            if self._maxsize > 0:
+                ok = self._cond.wait_for(
+                    lambda: len(self._q) < self._maxsize, timeout=timeout)
+                if not ok:
+                    return False
+            self._q.append(item)
+            self._cond.notify_all()
+            return True
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cond:
+            ok = self._cond.wait_for(lambda: len(self._q) > 0,
+                                     timeout=timeout)
+            if not ok:
+                return (False, None)
+            item = self._q.popleft()
+            self._cond.notify_all()
+            return (True, item)
+
+    def qsize(self) -> int:
+        return len(self._q)
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0):
+        self._actor = _QueueActor.options(
+            max_concurrency=8, num_cpus=0).remote(maxsize)
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None):
+        ok = ray.get(self._actor.put.remote(
+            item, timeout if block else 0.0))
+        if not ok:
+            raise Full()
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        ok, item = ray.get(self._actor.get.remote(
+            timeout if block else 0.0))
+        if not ok:
+            raise Empty()
+        return item
+
+    def put_async(self, item):
+        return self._actor.put.remote(item, None)
+
+    def get_async(self):
+        return self._actor.get.remote(None)
+
+    def qsize(self) -> int:
+        return ray.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def shutdown(self):
+        try:
+            ray.kill(self._actor)
+        except Exception:
+            pass

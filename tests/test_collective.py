@@ -1,0 +1,109 @@
+"""Host-collective tests (reference pattern:
+python/ray/util/collective/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture
+def ray8():
+    rt = ray.init(num_cpus=8)
+    yield rt
+    ray.shutdown()
+
+
+@ray.remote
+class Member:
+    def execute(self, fn, *a, **kw):
+        return fn(*a, **kw)
+
+    def do_allreduce(self, rank):
+        return col.allreduce(np.full(4, rank + 1.0), op="sum")
+
+    def do_allgather(self, rank):
+        return col.allgather(np.array([rank], np.float32))
+
+    def do_reducescatter(self, rank):
+        return col.reducescatter(np.arange(8, dtype=np.float32), op="sum")
+
+    def do_broadcast(self, rank):
+        arr = np.full(3, 42.0) if rank == 0 else np.zeros(3)
+        return col.broadcast(arr, src_rank=0)
+
+    def do_sendrecv(self, rank):
+        if rank == 0:
+            col.send(np.array([7.0, 8.0]), dst_rank=1)
+            return None
+        return col.recv(src_rank=0)
+
+
+def _make_group(n):
+    members = [Member.options(num_cpus=1).remote() for _ in range(n)]
+    col.create_collective_group(members, n, list(range(n)))
+    return members
+
+
+def test_allreduce_sum(ray8):
+    members = _make_group(3)
+    outs = ray.get([m.do_allreduce.remote(i) for i, m in enumerate(members)])
+    for o in outs:
+        assert np.allclose(o, np.full(4, 1.0 + 2.0 + 3.0))
+
+
+def test_allgather(ray8):
+    members = _make_group(3)
+    outs = ray.get([m.do_allgather.remote(i) for i, m in enumerate(members)])
+    for o in outs:
+        assert [float(x[0]) for x in o] == [0.0, 1.0, 2.0]
+
+
+def test_reducescatter(ray8):
+    members = _make_group(2)
+    outs = ray.get([m.do_reducescatter.remote(i)
+                    for i, m in enumerate(members)])
+    full = 2 * np.arange(8, dtype=np.float32)
+    assert np.allclose(outs[0], full[:4])
+    assert np.allclose(outs[1], full[4:])
+
+
+def test_broadcast(ray8):
+    members = _make_group(3)
+    outs = ray.get([m.do_broadcast.remote(i)
+                    for i, m in enumerate(members)])
+    for o in outs:
+        assert np.allclose(o, 42.0)
+
+
+def test_send_recv(ray8):
+    members = _make_group(2)
+    outs = ray.get([m.do_sendrecv.remote(i) for i, m in enumerate(members)])
+    assert outs[0] is None
+    assert np.allclose(outs[1], [7.0, 8.0])
+
+
+def test_actor_pool(ray8):
+    @ray.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    from ray_tpu.util import ActorPool
+    pool = ActorPool([Sq.remote() for _ in range(2)])
+    out = sorted(pool.map(lambda a, v: a.sq.remote(v), range(6)))
+    assert out == [0, 1, 4, 9, 16, 25]
+
+
+def test_distributed_queue(ray8):
+    from ray_tpu.util.queue import Queue, Empty
+    q = Queue(maxsize=4)
+    q.put({"a": 1})
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == {"a": 1}
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()

@@ -1,0 +1,96 @@
+"""Data-layer tests (reference pattern: python/ray/data/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import data as rd
+
+
+@pytest.fixture
+def ray8():
+    rt = ray.init(num_cpus=8)
+    yield rt
+    ray.shutdown()
+
+
+def test_range_map_filter_count(ray8):
+    ds = rd.range(100, parallelism=4)
+    assert ds.num_blocks() == 4
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 10 == 0)
+    assert out.count() == 20
+    assert sorted(out.take_all())[:3] == [0, 10, 20]
+
+
+def test_map_batches_numpy(ray8):
+    ds = rd.from_items([{"x": float(i)} for i in range(32)], parallelism=4)
+
+    def double(batch):
+        return {"x": batch["x"] * 2}
+
+    out = ds.map_batches(double, batch_format="numpy")
+    rows = out.take_all()
+    assert sorted(r["x"] for r in rows)[-1] == 62.0
+
+
+def test_flat_map_and_union(ray8):
+    ds = rd.range(5, parallelism=2).flat_map(lambda x: [x, x])
+    assert ds.count() == 10
+    u = ds.union(rd.range(3, parallelism=1))
+    assert u.count() == 13
+
+
+def test_random_shuffle_preserves_multiset(ray8):
+    ds = rd.range(50, parallelism=5)
+    sh = ds.random_shuffle(seed=7)
+    assert sorted(sh.take_all()) == list(range(50))
+    assert sh.take_all() != list(range(50))
+
+
+def test_sort(ray8):
+    ds = rd.from_items([{"k": i % 7, "v": i} for i in range(21)],
+                       parallelism=3)
+    out = ds.sort(key="k").take_all()
+    assert [r["k"] for r in out] == sorted(i % 7 for i in range(21))
+
+
+def test_split_for_train_shards(ray8):
+    ds = rd.range(64, parallelism=4)
+    shards = ds.split(4)
+    assert len(shards) == 4
+    assert all(s.count() == 16 for s in shards)
+    union = sorted(sum((s.take_all() for s in shards), []))
+    assert union == list(range(64))
+
+
+def test_iter_batches(ray8):
+    ds = rd.from_items([{"x": i} for i in range(10)], parallelism=2)
+    batches = list(ds.iter_batches(batch_size=4))
+    assert len(batches) == 3
+    assert batches[0]["x"].shape == (4,)
+    batches = list(ds.iter_batches(batch_size=4, drop_last=True))
+    assert len(batches) == 2
+
+
+def test_parquet_roundtrip(ray8, tmp_path):
+    ds = rd.from_items([{"a": i, "b": str(i)} for i in range(12)],
+                       parallelism=3)
+    ds.write_parquet(str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 12
+    assert sorted(r["a"] for r in back.take_all()) == list(range(12))
+
+
+def test_csv_json_roundtrip(ray8, tmp_path):
+    ds = rd.from_items([{"a": i} for i in range(6)], parallelism=2)
+    ds.write_csv(str(tmp_path / "csv"))
+    assert rd.read_csv(str(tmp_path / "csv")).count() == 6
+    ds.write_json(str(tmp_path / "js"))
+    assert rd.read_json(str(tmp_path / "js")).count() == 6
+
+
+def test_stats_and_schema(ray8):
+    ds = rd.from_items([{"x": float(i)} for i in range(10)], parallelism=2)
+    assert ds.sum("x") == 45.0
+    assert ds.mean("x") == 4.5
+    assert ds.schema() == {"x": "float"}

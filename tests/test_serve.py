@@ -1,0 +1,102 @@
+"""Serve-layer tests (reference pattern: python/ray/serve/tests)."""
+
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import serve
+
+
+@pytest.fixture
+def ray8():
+    rt = ray.init(num_cpus=8)
+    yield rt
+    serve.shutdown()
+    ray.shutdown()
+
+
+def test_function_deployment(ray8):
+    @serve.deployment
+    def echo(body):
+        return {"echo": body}
+
+    handle = serve.run(echo)
+    out = ray.get(handle.remote({"x": 1}))
+    assert out == {"echo": {"x": 1}}
+
+
+def test_class_deployment_with_state(ray8):
+    @serve.deployment(num_replicas=1)
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def __call__(self, body):
+            self.n += 1
+            return self.n
+
+    handle = serve.run(Counter.bind(10))
+    vals = [ray.get(handle.remote({})) for _ in range(3)]
+    assert vals == [11, 12, 13]
+
+
+def test_multiple_replicas_round_robin(ray8):
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __call__(self, body):
+            import os
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {ray.get(handle.remote({})) for _ in range(6)}
+    assert len(pids) == 2
+
+
+def test_scale_and_reconcile(ray8):
+    @serve.deployment(num_replicas=1)
+    class S:
+        def __call__(self, body):
+            return "ok"
+
+    serve.run(S.bind(), name="s")
+    controller = serve._get_controller() if hasattr(serve, "_get_controller") \
+        else None
+    from ray_tpu.serve.api import _get_controller
+    controller = _get_controller()
+    ray.get(controller.scale.remote("s", 3))
+    assert len(ray.get(controller.get_replicas.remote("s"))) == 3
+    ray.get(controller.scale.remote("s", 1))
+    assert len(ray.get(controller.get_replicas.remote("s"))) == 1
+
+
+def test_dead_replica_replacement(ray8):
+    @serve.deployment(num_replicas=2)
+    class D:
+        def __call__(self, body):
+            return "alive"
+
+    serve.run(D.bind(), name="d")
+    from ray_tpu.serve.api import _get_controller
+    controller = _get_controller()
+    reps = ray.get(controller.get_replicas.remote("d"))
+    ray.kill(reps[0])
+    time.sleep(0.3)
+    counts = ray.get(controller.reconcile.remote())
+    assert counts["d"] == 2
+
+
+def test_http_proxy_end_to_end(ray8):
+    import requests
+
+    @serve.deployment(route_prefix="/classify")
+    def classify(body):
+        return {"label": "cat", "score": body.get("score", 0.5)}
+
+    serve.run(classify)
+    url = serve.start_http_proxy(port=18472)
+    r = requests.post(f"{url}/classify", json={"score": 0.9}, timeout=10)
+    assert r.status_code == 200
+    assert r.json()["result"]["label"] == "cat"
+    r404 = requests.get(f"{url}/nope", timeout=10)
+    assert r404.status_code == 404
