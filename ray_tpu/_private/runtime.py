@@ -57,7 +57,7 @@ class ObjectState:
     __slots__ = (
         "status", "descr", "local_refs", "worker_refs", "pins",
         "futures", "waiters", "task_id", "value", "has_value", "segment",
-        "nested_ids",
+        "nested_ids", "shipped",
     )
 
     def __init__(self, task_id: Optional[TaskID] = None):
@@ -72,6 +72,10 @@ class ObjectState:
         self.value = None
         self.has_value = False
         self.segment = None
+        # True once this object's descriptor left the process (a worker may
+        # hold zero-copy views over the segment) or was mapped locally —
+        # such segments must not be pooled for in-place reuse.
+        self.shipped = False
         # ObjectIDs (binary) of refs pickled inside this object's value;
         # pinned until this object is freed.
         self.nested_ids: List[bytes] = []
@@ -252,7 +256,8 @@ class Runtime:
         self.lock = threading.RLock()
         self._tls = threading.local()
         self.shm = ShmStore(config.shm_dir, config.object_store_memory,
-                            self.session_id)
+                            self.session_id,
+                            pool_bytes=config.shm_pool_bytes)
 
         self.objects: Dict[ObjectID, ObjectState] = {}
         self.tasks: Dict[bytes, TaskRecord] = {}
@@ -411,7 +416,8 @@ class Runtime:
         if st.refcount() <= 0 and not st.futures and not st.waiters:
             self.objects.pop(oid, None)
             if st.descr is not None and st.descr[0] == protocol.SHM:
-                self.shm.unlink(st.descr[1], st.descr[2])
+                self.shm.unlink(st.descr[1], st.descr[2],
+                                reusable=not st.shipped)
             if st.segment is not None:
                 st.segment.close()
             if st.nested_ids:
@@ -420,10 +426,13 @@ class Runtime:
 
     # ------------------------------------------------------------ objects --
     def serialize_value(self, value, object_id: ObjectID):
-        data = serialization.dumps_inline(value)
-        if len(data) <= self.config.max_inline_object_size:
-            return (protocol.INLINE, data)
-        name, size = self.shm.create(object_id, value)
+        # One serialization pass; shm buffers are memcpy'd exactly once,
+        # directly into the segment (plasma create→write-in-place→seal).
+        res = serialization.dumps_adaptive(
+            value, self.config.max_inline_object_size)
+        if res[0] == "inline":
+            return (protocol.INLINE, res[1])
+        name, size = self.shm.create_from_parts(object_id, res[1], res[2])
         return (protocol.SHM, name, size)
 
     def put_object(self, value):
@@ -495,6 +504,11 @@ class Runtime:
             if st.has_value and st.status == READY:
                 return st.value
             descr = st.descr
+            if descr is not None and descr[0] == protocol.SHM:
+                # Marked before attaching (which happens outside the lock):
+                # a concurrent free must not pool and reuse the segment's
+                # inode while we are mapping/deserializing it.
+                st.shipped = True
         kind = descr[0]
         if kind == protocol.INLINE:
             value = serialization.loads_inline(descr[1])
@@ -865,6 +879,7 @@ class Runtime:
                         f"Dependency {oid.hex()} lost")
                 if st.status == ERRORED:
                     return st.descr  # error propagates to the task
+                st.shipped = True
                 return st.descr
             return a
 
@@ -1371,6 +1386,7 @@ class Runtime:
                     return
                 ok = st.status == READY
                 descr = st.descr
+                st.shipped = True
             worker.send(("obj", rid, ok, descr))
 
         def timed_out():

@@ -42,6 +42,36 @@ def loads(meta: bytes, buffers: List[memoryview]) -> Any:
     return pickle.loads(meta, buffers=buffers)
 
 
+def dumps_adaptive(value: Any, max_inline: int):
+    """One serialization pass deciding inline vs out-of-band placement.
+
+    Returns ``("inline", data)`` for values whose serialized form fits
+    ``max_inline`` (data is a self-contained in-band pickle stream), else
+    ``("parts", meta, buffer_views, total_size)`` for the shm path where
+    each buffer is memcpy'd exactly once into the segment.
+
+    When no out-of-band buffers were captured, ``meta`` is already a
+    complete loadable stream — no second pickle pass.
+    """
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    if not buffers:
+        if len(meta) <= max_inline:
+            return ("inline", meta)
+        return ("parts", meta, [], len(meta))
+    views = []
+    for b in buffers:
+        raw = b.raw()
+        if not raw.contiguous:
+            raw = memoryview(bytes(raw))
+        views.append(raw.cast("B"))
+    total = len(meta) + sum(len(v) for v in views)
+    if total <= max_inline:
+        # Small-but-buffered (e.g. a tiny ndarray): re-pickle in-band.
+        return ("inline", cloudpickle.dumps(value, protocol=5))
+    return ("parts", meta, views, total)
+
+
 def dumps_inline(value: Any) -> bytes:
     """Single-buffer serialization for small objects carried inside protocol
     messages (reference: inline objects below max_direct_call_object_size,

@@ -19,6 +19,7 @@ host arrays as views over the mapping — same guarantee plasma gives
 
 from __future__ import annotations
 
+import bisect
 import mmap
 import os
 import struct
@@ -85,20 +86,36 @@ class ShmStore:
     """
 
     def __init__(self, shm_dir: str = "/dev/shm", capacity: int = 0,
-                 session_id: str = ""):
+                 session_id: str = "", pool_bytes: int = 0):
         self._dir = shm_dir if os.path.isdir(shm_dir) else "/tmp"
         self._capacity = capacity
         self._session = session_id or os.urandom(4).hex()
         self._lock = threading.Lock()
         self._used = 0
         self._created: set[str] = set()
+        # Segment pool: freed-but-still-mapped segments kept for reuse.
+        # Fresh tmpfs pages cost a fault + zero-fill per 4K page (~1 GB/s on
+        # a TPU VM); writing through an already-faulted mapping runs at
+        # memcpy speed (~8 GB/s).  This is the moral equivalent of plasma's
+        # single pre-mapped arena + dlmalloc (``plasma/dlmalloc.cc``):
+        # allocate pages once, recycle them across objects.  Only segments
+        # whose descriptor never left this process may be pooled (the
+        # caller passes ``reusable=True``) — otherwise another process may
+        # still hold zero-copy views over the old inode.
+        self._pool_limit = pool_bytes
+        self._pool_bytes = 0
+        self._pool: List[Tuple[int, str, mmap.mmap]] = []  # sorted by size
+        self._live_mm: dict = {}  # name -> (mmap, alloc_size), pool=True only
 
     def segment_name(self, object_id: ObjectID) -> str:
         return f"rtpu-{self._session}-{object_id.hex()}"
 
-    def create(self, object_id: ObjectID, value: Any) -> Tuple[str, int]:
-        """Serialize ``value`` into a new segment; returns (name, size)."""
-        meta, buffers = serialization.dumps(value)
+    def create_from_parts(self, object_id: ObjectID, meta: bytes,
+                          buffers: List[memoryview]) -> Tuple[str, int]:
+        """Write pre-serialized (meta, out-of-band buffers) into a segment —
+        the plasma create→write-in-place→seal path (``plasma/client.cc``):
+        the caller serializes once and each buffer is memcpy'd exactly once,
+        directly into shared memory."""
         sizes = [len(b) for b in buffers]
         # Reserve space for the header + buffer table pickle.  The table is
         # pickled together with the payload meta so readers need one load.
@@ -119,12 +136,58 @@ class ShmStore:
             offsets, total = serialization.aligned_offsets(sizes, base)
             table = serialization.dumps_inline((offsets, sizes, meta))
 
-        if self._capacity and self._used + total > self._capacity:
-            raise MemoryError(
-                f"Object store over capacity: need {total}, "
-                f"used {self._used}/{self._capacity}"
-            )
+        name, mm, alloc = self._acquire_segment(object_id, total)
+        _HEADER.pack_into(mm, 0, _MAGIC, len(table))
+        mm[_HEADER.size : _HEADER.size + len(table)] = table
+        for off, buf in zip(offsets, buffers):
+            mm[off : off + len(buf)] = buf
+        if self._pool_limit:
+            # Keep the mapping open so a future reuse writes through
+            # already-faulted pages; released in unlink()/cleanup().
+            with self._lock:
+                self._live_mm[name] = (mm, alloc)
+        else:
+            mm.close()
+        with self._lock:
+            self._used += alloc
+            self._created.add(name)
+        return name, alloc
 
+    def _acquire_segment(self, object_id: ObjectID, total: int):
+        """A writable mapping of >= ``total`` bytes: pooled if one fits
+        (within 2x waste), else a fresh shm file.  Fresh allocations evict
+        pooled (free) segments first when that makes room under capacity."""
+        evict = []
+        with self._lock:
+            for i, (size, name, mm) in enumerate(self._pool):
+                if size >= total:
+                    if size <= 2 * total + (1 << 20):
+                        self._pool.pop(i)
+                        self._pool_bytes -= size
+                        self._used -= size  # re-added by create_from_parts
+                        return name, mm, size
+                    break  # sorted: everything later is even more wasteful
+            if self._capacity:
+                # Pooled bytes are free memory: give them back before
+                # declaring the store full.
+                while self._used + total > self._capacity and self._pool:
+                    size, name, mm = self._pool.pop()
+                    self._pool_bytes -= size
+                    self._used -= size
+                    evict.append((name, mm))
+                if self._used + total > self._capacity:
+                    raise MemoryError(
+                        f"Object store over capacity: need {total}, "
+                        f"used {self._used}/{self._capacity}")
+        for name, mm in evict:
+            try:
+                mm.close()
+            except BufferError:
+                pass
+            try:
+                os.unlink(_segment_path(self._dir, name))
+            except OSError:
+                pass
         name = self.segment_name(object_id)
         path = _segment_path(self._dir, name)
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
@@ -133,15 +196,7 @@ class ShmStore:
             mm = mmap.mmap(fd, total)
         finally:
             os.close(fd)
-        _HEADER.pack_into(mm, 0, _MAGIC, len(table))
-        mm[_HEADER.size : _HEADER.size + len(table)] = table
-        for off, buf in zip(offsets, buffers):
-            mm[off : off + len(buf)] = buf
-        mm.close()
-        with self._lock:
-            self._used += total
-            self._created.add(name)
-        return name, total
+        return name, mm, total
 
     def attach(self, name: str) -> Segment:
         path = _segment_path(self._dir, name)
@@ -153,7 +208,26 @@ class ShmStore:
             os.close(fd)
         return Segment(name, path, size, mm)
 
-    def unlink(self, name: str, size: int = 0):
+    def unlink(self, name: str, size: int = 0, reusable: bool = False):
+        """Free a segment.  ``reusable=True`` (caller guarantees no other
+        process ever saw this segment's descriptor) pools the still-open
+        mapping for in-place reuse instead of returning pages to the kernel.
+        """
+        with self._lock:
+            entry = self._live_mm.pop(name, None)
+            if (reusable and entry is not None
+                    and self._pool_bytes + entry[1] <= self._pool_limit):
+                mm, alloc = entry
+                bisect.insort(self._pool, (alloc, name, mm),
+                              key=lambda t: t[0])
+                self._pool_bytes += alloc
+                self._created.discard(name)
+                return
+        if entry is not None:
+            try:
+                entry[0].close()
+            except BufferError:
+                pass
         path = _segment_path(self._dir, name)
         try:
             os.unlink(path)
@@ -168,8 +242,19 @@ class ShmStore:
         """Unlink everything this process created (driver shutdown path)."""
         with self._lock:
             names = list(self._created)
+            names += [name for _, name, _ in self._pool]
+            mms = [mm for mm, _ in self._live_mm.values()]
+            mms += [mm for _, _, mm in self._pool]
             self._created.clear()
+            self._live_mm.clear()
+            self._pool.clear()
+            self._pool_bytes = 0
             self._used = 0
+        for mm in mms:
+            try:
+                mm.close()
+            except BufferError:
+                pass
         for name in names:
             try:
                 os.unlink(_segment_path(self._dir, name))

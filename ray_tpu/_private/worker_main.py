@@ -127,11 +127,12 @@ class _WorkerRuntime:
         raise ValueError(f"bad descriptor {descr!r}")
 
     def serialize_value(self, value: Any, object_id: ObjectID):
-        """Value -> descriptor, choosing inline vs shm by size."""
-        data = serialization.dumps_inline(value)
-        if len(data) <= self.max_inline:
-            return (protocol.INLINE, data)
-        name, size = self.shm.create(object_id, value)
+        """Value -> descriptor, choosing inline vs shm by size (one
+        serialization pass; shm buffers memcpy'd once, into the segment)."""
+        res = serialization.dumps_adaptive(value, self.max_inline)
+        if res[0] == "inline":
+            return (protocol.INLINE, res[1])
+        name, size = self.shm.create_from_parts(object_id, res[1], res[2])
         return (protocol.SHM, name, size)
 
     # -- runtime accessor API (mirrors driver Runtime) ---------------------
