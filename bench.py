@@ -1,13 +1,16 @@
-"""Round benchmark: mirrors the reference's microbenchmark harness
-(`python/ray/_private/ray_perf.py:93`, numbers in BASELINE.md) on this
-framework's core runtime, and prints ONE JSON line:
+"""Round benchmark: core-runtime microbenchmarks mirroring the reference's
+harness (`python/ray/_private/ray_perf.py:93`, numbers in BASELINE.md) plus
+TPU compute benchmarks (flash attention, flagship train step) on the real
+chip.  Prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "tpu": {...}}
 
 value/vs_baseline = geometric mean of (ours / reference-published) over the
 core task/actor/object microbenchmarks — 1.0 is parity with the numbers the
 reference repo publishes for itself (release_logs/2.3.0/microbenchmark.json).
-Per-metric results go to stderr for the curious.
+The "tpu" dict carries device-compute numbers (tokens/s, MFU, flash-attention
+timings) that the reference has no counterpart for (its release tests assert
+completion, not throughput).  Per-metric results go to stderr.
 """
 
 import json
@@ -19,10 +22,16 @@ import time
 BASELINE = {
     "single_client_tasks_sync": 1304.0,
     "single_client_tasks_async": 11031.0,
+    "multi_client_tasks_async": 28385.0,
     "one_one_actor_calls_sync": 2142.0,
     "one_one_actor_calls_async": 8099.0,
     "one_n_actor_calls_async": 10962.0,
+    "n_n_actor_calls_async": 32387.0,
+    "single_client_get_calls": 5902.0,
     "single_client_put_gigabytes": 20.4,
+    "multi_client_put_gigabytes": 36.2,
+    "single_client_wait_1k_refs": 5.45,
+    "single_client_get_object_containing_10k_refs": 13.3,
 }
 
 
@@ -33,10 +42,12 @@ def timeit(fn, n, warmup=50):
     return n / (time.perf_counter() - t0)
 
 
-def main():
+def core_bench():
+    import numpy as np
+
     import ray_tpu as ray
-    # 8 worker-pool CPUs for tasks + 9 actors (1 CPU each) below.
-    ray.init(num_cpus=17)
+    # 8 worker-pool CPUs for tasks + client/server actors below.
+    ray.init(num_cpus=24)
 
     @ray.remote
     def f():
@@ -46,6 +57,27 @@ def main():
     class Actor:
         def m(self):
             return None
+
+    @ray.remote
+    class Client:
+        """Driver-proxy submitting work from a worker process
+        (ray_perf's 'multi client' metrics)."""
+
+        def run_tasks(self, n):
+            import ray_tpu as ray
+            ray.get([f.remote() for _ in range(n)])
+
+        def call_actor(self, target, n):
+            import ray_tpu as ray
+            ray.get([target.m.remote() for _ in range(n)])
+
+        def put_bytes(self, nbytes, reps):
+            import numpy as np
+
+            import ray_tpu as ray
+            a = np.zeros(nbytes, dtype=np.uint8)
+            for _ in range(reps):
+                ray.put(a)
 
     results = {}
 
@@ -59,6 +91,14 @@ def main():
         ray.get([f.remote() for _ in range(n)])
 
     results["single_client_tasks_async"] = timeit(tasks_async, 3000)
+
+    clients = [Client.remote() for _ in range(4)]
+
+    def multi_tasks_async(n):
+        per = n // len(clients)
+        ray.get([c.run_tasks.remote(per) for c in clients])
+
+    results["multi_client_tasks_async"] = timeit(multi_tasks_async, 4000, 400)
 
     a = Actor.remote()
     ray.get(a.m.remote())
@@ -83,7 +123,31 @@ def main():
 
     results["one_n_actor_calls_async"] = timeit(one_n_async, 4000)
 
-    import numpy as np
+    targets = [Actor.remote() for _ in range(4)]
+    ray.get([t.m.remote() for t in targets])
+
+    def n_n_async(n):
+        per = n // len(clients)
+        ray.get([c.call_actor.remote(t, per)
+                 for c, t in zip(clients, targets)])
+
+    results["n_n_actor_calls_async"] = timeit(n_n_async, 4000, 400)
+
+    # get calls on shm-resident objects: fresh refs each round so the
+    # runtime's value cache cannot short-circuit deserialization; the puts
+    # happen OUTSIDE the timed region (baseline measures gets only).
+    small = np.zeros(1310720, dtype=np.uint8)  # ~1.3MB > inline cutoff
+    warm = [ray.put(small) for _ in range(50)]
+    for r in warm:
+        ray.get(r)
+    del warm
+    refs = [ray.put(small) for _ in range(500)]
+    t0 = time.perf_counter()
+    for r in refs:
+        ray.get(r)
+    results["single_client_get_calls"] = 500 / (time.perf_counter() - t0)
+    del refs
+
     arr = np.zeros(1024 * 1024 * 100, dtype=np.uint8)  # 100 MB
 
     def put_gb(n):
@@ -91,10 +155,158 @@ def main():
             ray.put(arr)
 
     gb = len(arr) / 1e9
-    rate = timeit(put_gb, 20, 2)
-    results["single_client_put_gigabytes"] = rate * gb
+    results["single_client_put_gigabytes"] = timeit(put_gb, 20, 3) * gb
+
+    def multi_put_gb(n):
+        reps = n // len(clients)
+        ray.get([c.put_bytes.remote(len(arr), reps) for c in clients])
+
+    results["multi_client_put_gigabytes"] = timeit(multi_put_gb, 12, 4) * gb
+
+    def wait_1k(n):
+        for _ in range(n):
+            refs = [f.remote() for _ in range(1000)]
+            ray.wait(refs, num_returns=1000, timeout=60)
+
+    results["single_client_wait_1k_refs"] = timeit(wait_1k, 8, 1)
+
+    def get_10k_container(n):
+        for _ in range(n):
+            inner = [ray.put(b"x") for _ in range(10000)]
+            box = ray.put(inner)
+            got = ray.get(box)
+            assert len(got) == 10000
+
+    # Baseline counts only the container-get; ours includes building it,
+    # so this under-reports rather than cheats.
+    results["single_client_get_object_containing_10k_refs"] = timeit(
+        get_10k_container, 4, 1)
 
     ray.shutdown()
+    return results
+
+
+# Peak bf16 FLOP/s by device kind (for MFU).
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def tpu_bench():
+    """Device-compute benchmarks on the real chip.  Returns {} off-TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print("  [tpu] no TPU backend; skipping device bench", file=sys.stderr)
+        return {}
+
+    dev = jax.devices()[0]
+    peak = _PEAK_FLOPS.get(dev.device_kind, 197e12)
+    out = {"device_kind": dev.device_kind, "peak_bf16_flops": peak}
+
+    import numpy as np
+
+    from ray_tpu.ops.attention import flash_attention, mha_reference
+
+    # Per-call host timing is unreliable through the remote-device tunnel
+    # (dispatch is async, sync fetches pay an RTT), so every measurement
+    # chains N dependent steps inside ONE jitted scan and divides.
+    def time_chained(attn, q, k, v, iters):
+        @jax.jit
+        def chain(q, k, v):
+            def loss(qq):
+                return attn(qq, k, v, causal=True).astype(jnp.float32).sum()
+
+            def body(c, _):
+                val, g = jax.value_and_grad(loss)(c)
+                return (c + 1e-6 * g.astype(c.dtype)), val
+
+            c, vals = jax.lax.scan(body, q, None, length=iters)
+            return c[0, 0, 0, 0] + vals.sum()
+
+        np.asarray(chain(q, k, v))  # compile + warm
+        t0 = time.perf_counter()
+        np.asarray(chain(q, k, v))
+        return (time.perf_counter() - t0) / iters
+
+    # Flash attention fwd+bwd vs the XLA reference, bf16 shapes.
+    b, h, d = 4, 16, 64
+    for seq in (2048, 8192):
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                     (b, seq, h, d), dtype=jnp.bfloat16)
+                   for i in range(3))
+        t_flash = time_chained(flash_attention, q, k, v, 16)
+        # fwd 4*b*h*s^2*d + bwd 2x = 12 (full, non-causal count).
+        flops = 12 * b * h * seq * seq * d
+        out[f"flash_attn_s{seq}_ms"] = round(t_flash * 1e3, 3)
+        out[f"flash_attn_s{seq}_tflops"] = round(flops / t_flash / 1e12, 1)
+        extra = ""
+        if seq <= 2048:
+            # The XLA reference materializes (s, s) scores — OOMs at 8k;
+            # its existence at 2k is the speedup context.
+            t_ref = time_chained(mha_reference, q, k, v, 16)
+            out[f"flash_attn_s{seq}_vs_xla"] = round(t_ref / t_flash, 3)
+            extra = f", {t_ref/t_flash:.2f}x XLA ref"
+        print(f"  [tpu] flash s={seq}: {t_flash*1e3:.2f}ms "
+              f"({flops/t_flash/1e12:.1f} TF/s full-count{extra})",
+              file=sys.stderr)
+
+    # Flagship train step: tokens/s + MFU.
+    import optax
+
+    from __graft_entry__ import _flagship_cfg
+    from ray_tpu.train import init_train_state, make_train_step
+
+    cfg = _flagship_cfg()
+    batch, seq = 8, cfg.max_seq_len
+    opt = optax.adamw(1e-3)
+    state = init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    step = make_train_step(cfg, opt, donate=False)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    iters = 10
+
+    @jax.jit
+    def run(state, tokens):
+        def body(s, _):
+            s2, m = step(s, {"tokens": tokens})
+            return s2, m["loss"]
+        return jax.lax.scan(body, state, None, length=iters)
+
+    s2, losses = run(state, tokens)   # compile + warm
+    np.asarray(losses)
+    t0 = time.perf_counter()
+    _, losses = run(state, tokens)
+    np.asarray(losses)
+    dt = (time.perf_counter() - t0) / iters
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+    toks = batch * seq
+    # 6N per token (fwd+bwd matmuls) + attention 12*L*s*h*d per token.
+    step_flops = toks * (6 * n_params
+                         + 12 * cfg.num_layers * seq * cfg.num_heads
+                         * cfg.head_dim)
+    mfu = step_flops / dt / peak
+    out["train_step_ms"] = round(dt * 1e3, 2)
+    out["train_tokens_per_s"] = round(toks / dt)
+    out["train_mfu"] = round(mfu, 4)
+    out["model_params_m"] = round(n_params / 1e6, 1)
+    print(f"  [tpu] train step: {dt*1e3:.1f}ms, {toks/dt:,.0f} tok/s, "
+          f"MFU {mfu*100:.1f}% ({n_params/1e6:.0f}M params, "
+          f"{dev.device_kind})", file=sys.stderr)
+    return out
+
+
+def main():
+    results = core_bench()
 
     ratios = []
     for k, v in results.items():
@@ -106,11 +318,19 @@ def main():
     for r in ratios:
         geo *= r
     geo **= 1.0 / len(ratios)
+
+    try:
+        tpu = tpu_bench()
+    except Exception as e:  # noqa: BLE001 — device bench must not kill core
+        print(f"  [tpu] device bench failed: {e!r}", file=sys.stderr)
+        tpu = {"error": repr(e)}
+
     print(json.dumps({
         "metric": "core_microbench_geomean_vs_reference",
         "value": round(geo, 4),
         "unit": "x (1.0 = reference-published parity)",
         "vs_baseline": round(geo, 4),
+        "tpu": tpu,
     }))
 
 

@@ -25,19 +25,6 @@ import cloudpickle
 ALIGNMENT = 64
 
 
-def dumps(value: Any) -> Tuple[bytes, List[memoryview]]:
-    """Serialize to (meta, out-of-band buffers)."""
-    buffers: List[pickle.PickleBuffer] = []
-    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
-    views = []
-    for b in buffers:
-        raw = b.raw()
-        if not raw.contiguous:
-            raw = memoryview(bytes(raw))
-        views.append(raw.cast("B"))
-    return meta, views
-
-
 def loads(meta: bytes, buffers: List[memoryview]) -> Any:
     return pickle.loads(meta, buffers=buffers)
 

@@ -2,10 +2,19 @@
 
 Design (standard memory-efficient attention, mapped to the TPU grid model):
 
+- Layout: kernels run on ``(batch, heads, seq, head_dim)`` so every block's
+  minor two dims are ``(block_seq, head_dim)`` — Mosaic requires the minor
+  dims of a block to be (8, 128)-tile friendly or equal to the array dims;
+  the model-side ``(b, s, h, d)`` tensors are transposed at the call
+  boundary (XLA fuses the transpose into neighbouring ops).
 - Forward: grid ``(batch, heads, q_blocks, kv_blocks)``.  The last grid
   dimension is sequential on TPU, so softmax running stats ``(m, l)`` and the
   output accumulator live in VMEM scratch that persists across kv iterations;
   the normalized output and the logsumexp are written on the last kv block.
+- The logsumexp residual is lane-replicated to ``(b, h, s, LANES)`` — a 1D
+  row per q position cannot be expressed as a legal minor block shape, so
+  stats ride in full vector registers (the layout jax's own TPU
+  flash-attention kernel uses for its ``l``/``m`` outputs).
 - Backward: two kernels (the classic split): one accumulates ``dk, dv`` with
   grid ``(b, h, kv_blocks, q_blocks)``, one accumulates ``dq`` with grid
   ``(b, h, q_blocks, kv_blocks)``; both recompute ``p = exp(s - lse)`` from
@@ -13,9 +22,6 @@ Design (standard memory-efficient attention, mapped to the TPU grid model):
 - Causal blocks that are fully masked are skipped with ``pl.when`` so the
   kernel does ~half the FLOPs at long sequence.
 - Accumulation is f32 regardless of input dtype (bf16 inputs hit the MXU).
-
-Array layout is ``(batch, seq, heads, head_dim)`` (model-friendly); the grid
-iterates heads, so layout is handled by BlockSpec index maps, no transposes.
 
 The reference framework has no counterpart (Ray core has no tensor ops —
 SURVEY.md §5); this op is the compute leaf that the SP layer (ring/ulysses)
@@ -34,14 +40,27 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # finite "minus infinity": keeps exp() NaN-free on masked rows
-_LANES = 128     # TPU lane width; scratch stats are lane-replicated
+_LANES = 128     # TPU lane width; stats are lane-replicated
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Tuned on TPU v5e: large blocks amortize grid overhead (the d=64
+# contraction underfills the MXU, so throughput comes from big output
+# tiles); _fit_block shrinks them for short sequences.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 
 
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _compiler_params(interpret):
+    if interpret:
+        return None
+    # First three grid dims are embarrassingly parallel; the innermost
+    # carries the running softmax state and must stay sequential.
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -88,9 +107,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, :, 0, :]
-        k = k_ref[0, :, 0, :]
-        v = v_ref[0, :, 0, :]
+        q = q_ref[0, 0]                                      # (bq, d)
+        k = k_ref[0, 0]                                      # (bk, d)
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -109,14 +128,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_scr[:, :1]
-        o_ref[0, :, 0, :] = (acc_scr[...] / l).astype(o_ref.dtype)
-        lse_ref[0, 0, :] = m_scr[:, 0] + jnp.log(l[:, 0])
+        l = l_scr[...]
+        o_ref[0, 0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l)
 
 
-def _fwd_call(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+def _fwd_call(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret):
+    """qt/kt/vt: (b, h, s, d).  Returns (o_t, lse) with o_t (b, h, sq, d)
+    and lse (b, h, sq, LANES) lane-replicated f32."""
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     o, lse = pl.pallas_call(
@@ -124,25 +145,27 @@ def _fwd_call(q, k, v, sm_scale, causal, block_q, block_k, interpret):
                           block_q=block_q, block_k=block_k),
         grid=(b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
         in_specs=[
-            pl.BlockSpec((1, block_q, 1, d), lambda b_, h_, i, j: (b_, i, h_, 0)),
-            pl.BlockSpec((1, block_k, 1, d), lambda b_, h_, i, j: (b_, j, h_, 0)),
-            pl.BlockSpec((1, block_k, 1, d), lambda b_, h_, i, j: (b_, j, h_, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, 1, d), lambda b_, h_, i, j: (b_, i, h_, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct(qt.shape, qt.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(q, k, v)
+    )(qt, kt, vt)
     return o, lse
 
 
@@ -163,31 +186,31 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, :, 0, :]
-        k = k_ref[0, :, 0, :]
-        v = v_ref[0, :, 0, :]
-        do = do_ref[0, :, 0, :]
-        lse = lse_ref[0, 0, :]
-        delta = delta_ref[0, 0, :]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                                   # (bq, LANES)
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse[:, None])                         # (bq, bk)
+        p = jnp.exp(s - lse[:, :1])                           # (bq, bk)
         dv_scr[...] += jax.lax.dot_general(
             p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta[:, :1]) * sm_scale
         dk_scr[...] += jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0, :, 0, :] = dk_scr[...].astype(dk_ref.dtype)
-        dv_ref[0, :, 0, :] = dv_scr[...].astype(dv_ref.dtype)
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -203,44 +226,49 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, :, 0, :]
-        k = k_ref[0, :, 0, :]
-        v = v_ref[0, :, 0, :]
-        do = do_ref[0, :, 0, :]
-        lse = lse_ref[0, 0, :]
-        delta = delta_ref[0, 0, :]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse[:, :1])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta[:, :1]) * sm_scale
         dq_scr[...] += jax.lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0, :, 0, :] = dq_scr[...].astype(dq_ref.dtype)
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+def _bwd_call(qt, kt, vt, ot, lse, dot, sm_scale, causal, block_q, block_k,
               interpret):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+    """All tensors (b, h, s, d); lse (b, h, sq, LANES).  Returns transposed
+    grads (dqt, dkt, dvt)."""
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    delta = jnp.einsum("bqhd,bqhd->bhq", o.astype(jnp.float32),
-                       do.astype(jnp.float32))
+    delta = jnp.sum(ot.astype(jnp.float32) * dot.astype(jnp.float32),
+                    axis=-1, keepdims=True)                  # (b, h, sq, 1)
+    delta = jnp.broadcast_to(delta, (b, h, sq, _LANES))
 
-    q_i = pl.BlockSpec((1, block_q, 1, d), lambda b_, h_, i, j: (b_, i, h_, 0))
-    q_j = pl.BlockSpec((1, block_q, 1, d), lambda b_, h_, i, j: (b_, j, h_, 0))
-    k_i = pl.BlockSpec((1, block_k, 1, d), lambda b_, h_, i, j: (b_, i, h_, 0))
-    k_j = pl.BlockSpec((1, block_k, 1, d), lambda b_, h_, i, j: (b_, j, h_, 0))
-    row_i = pl.BlockSpec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i))
-    row_j = pl.BlockSpec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, j))
+    q_i = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    q_j = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    k_i = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    k_j = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    row_i = pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b_, h_, i, j: (b_, h_, i, 0))
+    row_j = pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b_, h_, i, j: (b_, h_, j, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkdv_kernel, sm_scale=sm_scale, causal=causal,
@@ -248,12 +276,13 @@ def _bwd_call(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
         grid=(b, h, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q)),
         in_specs=[q_j, k_i, k_i, q_j, row_j, row_j],
         out_specs=[k_i, k_i],
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(kt.shape, kt.dtype),
+                   jax.ShapeDtypeStruct(vt.shape, vt.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(qt, kt, vt, dot, lse, delta)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -261,30 +290,39 @@ def _bwd_call(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
         grid=(b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
         in_specs=[q_i, k_j, k_j, q_i, row_i, row_i],
         out_specs=q_i,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, qt.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(qt, kt, vt, dot, lse, delta)
     return dq, dk, dv
 
 
 # ----------------------------------------------------------------- public
 
+def _to_bhsd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, _ = _fwd_call(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return o
+    o, _ = _fwd_call(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), sm_scale, causal,
+                     block_q, block_k, interpret)
+    return _to_bhsd(o)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, lse = _fwd_call(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+    qt, kt, vt = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    ot, lse = _fwd_call(qt, kt, vt, sm_scale, causal, block_q, block_k,
+                        interpret)
+    return _to_bhsd(ot), (qt, kt, vt, ot, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
-    return _bwd_call(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
-                     interpret)
+    qt, kt, vt, ot, lse = res
+    dqt, dkt, dvt = _bwd_call(qt, kt, vt, ot, lse, _to_bhsd(do), sm_scale,
+                              causal, block_q, block_k, interpret)
+    return _to_bhsd(dqt), _to_bhsd(dkt), _to_bhsd(dvt)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

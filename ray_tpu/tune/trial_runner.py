@@ -125,7 +125,14 @@ class TrialRunner:
         trial.status = status
         if trial.actor is not None:
             try:
-                trial.actor.stop.remote()
+                # Graceful-then-force (reference: ray_trial_executor stop
+                # sequence): wait briefly for Trainable.cleanup() to run
+                # before the kill, or user teardown may never execute.
+                stop_fut = trial.actor.stop.remote()
+                try:
+                    ray.get(stop_fut, timeout=5.0)
+                except Exception:
+                    pass
                 ray.kill(trial.actor)
             except Exception:
                 pass

@@ -17,6 +17,9 @@ class ActorPool:
         self._future_to_meta = {}   # future -> (actor, submission index)
         self._pending = []          # queued (fn, value, index)
         self._next_idx = 0
+        self._next_return = 0       # next submission index get_next yields
+        self._ready = {}            # completed results buffered by index
+        self._consumed = set()      # indices taken out-of-order (unordered)
 
     def submit(self, fn: Callable[[Any, Any], Any], value: Any):
         """fn(actor, value) -> ObjectRef."""
@@ -29,7 +32,8 @@ class ActorPool:
             self._pending.append((fn, value, idx))
 
     def has_next(self) -> bool:
-        return bool(self._future_to_meta) or bool(self._pending)
+        return (bool(self._future_to_meta) or bool(self._pending)
+                or bool(self._ready))
 
     def _complete_one(self, timeout=None):
         done, _ = ray.wait(list(self._future_to_meta), num_returns=1,
@@ -46,13 +50,38 @@ class ActorPool:
         return idx, ray.get(fut)
 
     def get_next(self, timeout=None) -> Any:
-        """Next result in completion order."""
+        """Next result in SUBMISSION order (reference semantics:
+        ``_index_to_future``/``_next_return_index`` in
+        ``python/ray/util/actor_pool.py``) — interleaved submit()/get_next()
+        pairs inputs with outputs."""
         if not self.has_next():
             raise StopIteration("no pending work")
-        return self._complete_one(timeout)[1]
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while self._next_return in self._consumed:
+            self._consumed.discard(self._next_return)
+            self._next_return += 1
+        want = self._next_return
+        while want not in self._ready:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - _time.monotonic()))
+            idx, result = self._complete_one(remaining)
+            self._ready[idx] = result
+        self._next_return += 1
+        return self._ready.pop(want)
 
     def get_next_unordered(self, timeout=None) -> Any:
-        return self.get_next(timeout)
+        """Next result in COMPLETION order."""
+        if not self.has_next():
+            raise StopIteration("no pending work")
+        if self._ready:
+            # Results already fetched while waiting in-order: drain first.
+            idx = next(iter(self._ready))
+            self._consumed.add(idx)
+            return self._ready.pop(idx)
+        idx, result = self._complete_one(timeout)
+        self._consumed.add(idx)
+        return result
 
     def map(self, fn: Callable[[Any, Any], Any],
             values: Iterable[Any]) -> Iterator[Any]:
@@ -69,6 +98,7 @@ class ActorPool:
             if not self.has_next():
                 break
             idx, result = self._complete_one()
+            self._consumed.add(idx)
             buffered[idx] = result
         while want in buffered:
             yield buffered.pop(want)
@@ -78,4 +108,4 @@ class ActorPool:
         for v in values:
             self.submit(fn, v)
         while self.has_next():
-            yield self.get_next()
+            yield self.get_next_unordered()

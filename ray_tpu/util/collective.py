@@ -23,7 +23,11 @@ import numpy as np
 import ray_tpu as ray
 
 _GROUP_PREFIX = "collective_group:"
-_local = threading.local()
+# Process-scoped (NOT thread-local): in an actor with max_concurrency > 1,
+# method calls are serviced by different pool threads, so a group inited on
+# one thread must be visible to collective ops handled by another.
+_groups_lock = threading.Lock()
+_GROUPS: Dict[str, "_GroupState"] = {}
 
 
 @ray.remote
@@ -148,9 +152,8 @@ class _GroupState:
 
 
 def _groups() -> Dict[str, _GroupState]:
-    if not hasattr(_local, "groups"):
-        _local.groups = {}
-    return _local.groups
+    with _groups_lock:
+        return dict(_GROUPS)
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -158,13 +161,19 @@ def init_collective_group(world_size: int, rank: int,
     """Called by each participating actor/task (reference:
     collective.py:120)."""
     name = _GROUP_PREFIX + group_name
+    with _groups_lock:
+        if group_name in _GROUPS:
+            raise RuntimeError(
+                f"collective group {group_name!r} already initialized in "
+                f"this process")
     if rank == 0:
         coord = _Coordinator.options(
             name=name, max_concurrency=max(world_size + 2, 4),
             num_cpus=0).remote(world_size)
     else:
         coord = _wait_for_actor(name)
-    _groups()[group_name] = _GroupState(group_name, rank, world_size, coord)
+    with _groups_lock:
+        _GROUPS[group_name] = _GroupState(group_name, rank, world_size, coord)
 
 
 def _wait_for_actor(name, timeout=30.0):

@@ -51,9 +51,13 @@ class _QueueActor:
 
 
 class Queue:
-    def __init__(self, maxsize: int = 0):
+    def __init__(self, maxsize: int = 0, max_concurrency: int = 32):
+        # max_concurrency bounds how many callers may BLOCK inside the actor
+        # simultaneously (put/get with block=True hold a pool thread for the
+        # full wait); size it to the expected number of concurrent clients
+        # or blocked consumers could starve the put that would wake them.
         self._actor = _QueueActor.options(
-            max_concurrency=8, num_cpus=0).remote(maxsize)
+            max_concurrency=max_concurrency, num_cpus=0).remote(maxsize)
 
     def put(self, item, block: bool = True,
             timeout: Optional[float] = None):

@@ -67,7 +67,27 @@ def test_function_trainable_generator(ray6):
     assert best.metrics["value"] == 20
 
 
-def test_asha_early_stops_bad_trials(ray6):
+def test_asha_rung_logic_deterministic():
+    """Drive the scheduler directly with a fixed arrival order (ASHA's
+    stop decision depends on arrival order, so the integration-level
+    'someone was stopped' assertion is inherently racy)."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+    scheduler = AsyncHyperBandScheduler(
+        metric="score", mode="max", max_t=12, grace_period=2,
+        reduction_factor=2)
+    # Best-first arrival at the rung t=2: later, worse trials must stop.
+    assert scheduler.on_trial_result(
+        None, "t0", {"training_iteration": 2, "score": 10.0}) == CONTINUE
+    assert scheduler.on_trial_result(
+        None, "t1", {"training_iteration": 2, "score": 9.0}) == STOP
+    assert scheduler.on_trial_result(
+        None, "t2", {"training_iteration": 2, "score": 11.0}) == CONTINUE
+    # Reaching max_t stops unconditionally.
+    assert scheduler.on_trial_result(
+        None, "t0", {"training_iteration": 12, "score": 10.0}) == STOP
+
+
+def test_asha_integration_completes(ray6):
     scheduler = AsyncHyperBandScheduler(
         metric="score", mode="max", max_t=12, grace_period=2,
         reduction_factor=2)
@@ -79,7 +99,7 @@ def test_asha_early_stops_bad_trials(ray6):
     iters = {t.trial_id: t.last_result.get("training_iteration", 0)
              for t in grid.trials}
     assert max(iters.values()) == 12           # someone ran to completion
-    assert min(iters.values()) < 12            # someone was ASHA-stopped
+    assert grid.num_errors == 0
 
 
 def test_pbt_transfers_checkpoints(ray6):
