@@ -77,6 +77,20 @@ class Config:
     # safe with JAX in the driver ("fork" is not — XLA runtime threads).
     worker_start_method: str = "forkserver"
 
+    # Lineage-based object reconstruction (reference:
+    # object_recovery_manager.h:41): keep creating-task specs for owned
+    # task returns; a lost object is rebuilt by re-executing its task.
+    lineage_enabled: bool = True
+
+    # Where over-capacity shm objects spill (reference:
+    # local_object_manager.h:41 spill to external storage).  Empty =
+    # /tmp/ray_tpu_spill_<session>.
+    spill_dir: str = ""
+
+    # Host the head's TCP listener binds (node agents + their workers dial
+    # in here).  Use "0.0.0.0" for real multi-host clusters.
+    listen_host: str = "127.0.0.1"
+
     @classmethod
     def from_env(cls, overrides: dict | None = None) -> "Config":
         kwargs = {}

@@ -1,0 +1,181 @@
+"""Per-node agent process — the raylet-analog for non-head hosts.
+
+The reference runs one raylet per node (``src/ray/raylet/main.cc:318``,
+``node_manager.h:115``): it registers with the GCS, spawns language workers
+on demand, and embeds the local object store.  This agent is the condensed
+TPU-era equivalent:
+
+- dials the head's TCP listener and registers its node (resources, labels,
+  object-store id) — reference: ``NodeManager::RegisterGcs``;
+- spawns worker processes when the head's scheduler leases one here
+  (reference: ``worker_pool.cc``); workers dial the head directly, so the
+  agent stays out of the task hot path;
+- serves ``read_segment`` requests: reads a local shm segment's serialized
+  parts so the head can ship objects across nodes (the condensed form of
+  ``ObjectManager::Push/Pull``, ``object_manager.h:117,206``).
+
+Run: ``python -m ray_tpu._private.node_agent`` with RAY_TPU_HEAD_ADDRESS /
+RAY_TPU_AUTHKEY / RAY_TPU_AGENT_* env vars (see cluster_utils.Cluster).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from multiprocessing.connection import Client
+from typing import Dict
+
+from ray_tpu._private import protocol
+from ray_tpu._private.shm_store import ShmStore
+
+
+class NodeAgent:
+    def __init__(self, head_address: str, authkey: bytes,
+                 resources: Dict[str, float], shm_dir: str,
+                 labels: Dict[str, str]):
+        self.head_address = head_address
+        self.authkey = authkey
+        self.resources = resources
+        self.labels = labels
+        self.store_id = os.urandom(8).hex()
+        self.shm_dir = shm_dir
+        os.makedirs(shm_dir, exist_ok=True)
+        self.conn = None
+        self.send_lock = threading.Lock()
+        self.workers: Dict[str, subprocess.Popen] = {}
+        self.session = ""
+        self._stopped = False
+
+    def _send(self, msg):
+        with self.send_lock:
+            protocol.send(self.conn, msg)
+
+    def connect(self):
+        addr = protocol.parse_address(self.head_address)
+        for attempt in range(40):
+            try:
+                self.conn = Client(addr, authkey=self.authkey)
+                break
+            except (ConnectionError, OSError):
+                time.sleep(0.1 * (attempt + 1))
+        if self.conn is None:
+            raise SystemExit("node agent: cannot reach head at "
+                             + self.head_address)
+        self._send(("agent_ready", {
+            "resources": self.resources,
+            "labels": self.labels,
+            "store_id": self.store_id,
+            "shm_dir": self.shm_dir,
+            "pid": os.getpid(),
+            "hostname": os.uname().nodename,
+        }))
+        msg = protocol.recv(self.conn)
+        assert msg[0] == "agent_ack", msg
+        self.node_id_hex = msg[1]
+        self.session = msg[2]
+        # Attach-only store for read_segment (segments here are created by
+        # this node's workers; the agent never allocates).
+        self.store = ShmStore(shm_dir=self.shm_dir, session_id=self.session)
+
+    def serve(self):
+        while not self._stopped:
+            try:
+                msg = protocol.recv(self.conn)
+            except (EOFError, OSError):
+                break  # head is gone: shut down the node
+            tag = msg[0]
+            if tag == "spawn_worker":
+                self._spawn_worker(msg[1], msg[2])
+            elif tag == "kill_worker":
+                self._kill_worker(msg[1])
+            elif tag == "read_segment":
+                threading.Thread(target=self._read_segment,
+                                 args=(msg[1], msg[2]), daemon=True).start()
+            elif tag == "unlink_segment":
+                # Owner freed an object homed here (the owner-driven
+                # deletion of local_object_manager.h:41).
+                self.store.unlink(msg[1], msg[2])
+            elif tag == "shutdown":
+                break
+        self.shutdown()
+
+    def _spawn_worker(self, worker_id_hex: str, env_overrides: Dict[str, str]):
+        env = dict(os.environ)
+        env.update(env_overrides)
+        env["RAY_TPU_SHM_DIR_OVERRIDE"] = self.shm_dir
+        env["RAY_TPU_STORE_ID"] = self.store_id
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = (pkg_root + (os.pathsep + existing
+                                         if existing else ""))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, cwd=pkg_root)
+        self.workers[worker_id_hex] = proc
+
+    def _kill_worker(self, worker_id_hex: str):
+        proc = self.workers.pop(worker_id_hex, None)
+        if proc is not None:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+
+    def _read_segment(self, rid, name: str):
+        try:
+            seg = self.store.attach(name)
+            meta, bufs = seg.raw_parts()
+            # Copy out before close: the reply pickles them anyway.
+            payload = (bytes(meta), [bytes(b) for b in bufs])
+            seg.close()
+            self._send(("segment", rid, True, payload))
+        except Exception as e:  # noqa: BLE001
+            self._send(("segment", rid, False, repr(e)))
+
+    def shutdown(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        for proc in self.workers.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        deadline = time.time() + 3.0
+        for proc in self.workers.values():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+def main():
+    agent = NodeAgent(
+        head_address=os.environ["RAY_TPU_HEAD_ADDRESS"],
+        authkey=bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"]),
+        resources=json.loads(os.environ.get("RAY_TPU_AGENT_RESOURCES",
+                                            '{"CPU": 1.0}')),
+        shm_dir=os.environ.get("RAY_TPU_AGENT_SHM_DIR",
+                               f"/tmp/ray_tpu_node_{os.getpid()}"),
+        labels=json.loads(os.environ.get("RAY_TPU_AGENT_LABELS", "{}")),
+    )
+    signal.signal(signal.SIGTERM, lambda *_: agent.shutdown() or sys.exit(0))
+    agent.connect()
+    agent.serve()
+
+
+if __name__ == "__main__":
+    main()

@@ -30,8 +30,17 @@ worker → driver
 
 Object descriptors (Descr) carry values between processes:
   ("inline", bytes)                 pickled value, small
-  ("shm", name, size)               shared-memory segment (zero-copy mmap)
+  ("shm", name, size, store_id)     shared-memory segment (zero-copy mmap,
+                                    attachable only by processes sharing the
+                                    creating host's object store)
+  ("parts", meta, [bytes...])       serialized parts shipped over the wire —
+                                    the cross-node transfer form (reference:
+                                    object_manager.h:206 chunked push/pull)
   ("error", bytes)                  pickled exception
+
+Transport: same message set over an AF_UNIX socket (workers on the head
+host) or TCP (node agents and the workers they spawn on other hosts) —
+the reference speaks gRPC for both (``node_manager.proto``).
 """
 
 from __future__ import annotations
@@ -49,4 +58,21 @@ def recv(conn) -> tuple:
 
 INLINE = "inline"
 SHM = "shm"
+PARTS = "parts"
+SPILLED = "spilled"  # ("spilled", path, size, store_id): on-disk segment
 ERROR = "error"
+
+
+def format_address(addr) -> str:
+    """Listener address -> env-var string ("tcp://host:port" or a path)."""
+    if isinstance(addr, tuple):
+        return f"tcp://{addr[0]}:{addr[1]}"
+    return addr
+
+
+def parse_address(s: str):
+    """Env-var string -> Client()-compatible address."""
+    if s.startswith("tcp://"):
+        host, port = s[len("tcp://"):].rsplit(":", 1)
+        return (host, int(port))
+    return s

@@ -188,6 +188,54 @@ class WorkerHandle:
             self.outbox.clear()
 
 
+class AgentHandle:
+    """Head-side proxy for one node agent daemon (reference: the GCS's
+    per-raylet NodeManager client, gcs_node_manager.h:41)."""
+
+    def __init__(self, conn, store_id: str, shm_dir: str, info: dict):
+        self.conn = conn
+        self.store_id = store_id
+        self.shm_dir = shm_dir
+        self.info = info
+        self.send_lock = threading.Lock()
+        self.node: Optional["NodeState"] = None
+        self.dead = False
+        self._rid = 0
+        self._pending: Dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+
+    def send(self, msg):
+        with self.send_lock:
+            protocol.send(self.conn, msg)
+
+    def request_segment(self, name: str, timeout: float = 30.0):
+        """Blocking read of a remote segment's serialized parts.  Must be
+        called WITHOUT the runtime lock held."""
+        with self._pending_lock:
+            self._rid += 1
+            rid = self._rid
+            fut = self._pending[rid] = Future()
+        self.send(("read_segment", rid, name))
+        ok, payload = fut.result(timeout=timeout)
+        if not ok:
+            raise exc.ObjectLostError(
+                f"remote segment {name} unreadable: {payload}")
+        return payload  # (meta, [bytes...])
+
+    def deliver(self, rid, ok, payload):
+        with self._pending_lock:
+            fut = self._pending.pop(rid, None)
+        if fut is not None:
+            fut.set_result((ok, payload))
+
+    def fail_all(self, err):
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_result((False, repr(err)))
+
+
 class NodeState:
     """One schedulable node.  In-process multi-node (the cluster_utils.Cluster
     pattern, reference python/ray/cluster_utils.py:99) gives several NodeStates
@@ -196,10 +244,11 @@ class NodeState:
 
     __slots__ = (
         "node_id", "resources", "available", "labels", "idle_workers",
-        "all_workers", "tpu_free", "alive",
+        "all_workers", "tpu_free", "alive", "agent", "store_id",
     )
 
-    def __init__(self, node_id, resources, labels=None):
+    def __init__(self, node_id, resources, labels=None, agent=None,
+                 store_id=""):
         self.node_id = node_id
         self.resources = dict(resources)
         self.available = dict(resources)
@@ -208,6 +257,11 @@ class NodeState:
         self.all_workers: Dict[int, WorkerHandle] = {}
         self.tpu_free: List[int] = list(range(int(resources.get("TPU", 0))))
         self.alive = True
+        # Out-of-process nodes (real multi-host) have a per-node agent
+        # daemon (the raylet analog, _private/node_agent.py) and their own
+        # object store; in-process test nodes share the head's store.
+        self.agent: Optional["AgentHandle"] = agent
+        self.store_id = store_id
 
     def can_fit(self, req: Dict[str, float]) -> bool:
         return all(self.available.get(k, 0.0) >= v - 1e-9
@@ -275,12 +329,23 @@ class Runtime:
         # (Reference: per-SchedulingKey lease queues in
         # direct_task_transport.h:75 / scheduling classes.)
         self.pending_tasks: Dict[tuple, deque] = {}
+        # Lineage: creating-task spec kept while any of its return objects
+        # is alive, so a lost object can be rebuilt by re-execution
+        # (reference: object_recovery_manager.h:41, task_manager.h:174
+        # lineage pinning).  {task_id_bytes: {"spec":, "alive": set}}
+        self.lineage: Dict[bytes, dict] = {}
         self.functions: Dict[str, bytes] = {}
         self.worker_funcs: Dict[int, set] = {}  # conn fileno -> func_ids sent
         self.task_events: deque = deque(maxlen=10000)
         self.events: Dict[str, deque] = {}  # topic -> payload bytes
         self._conn_to_worker: Dict[Any, WorkerHandle] = {}
+        self._conn_to_agent: Dict[Any, AgentHandle] = {}
+        self._agents: Dict[str, AgentHandle] = {}  # store_id -> handle
         self._pending_workers: Dict[str, WorkerHandle] = {}
+        # Identity of this process's object store: SHM descriptors carry it
+        # so consumers know whether a segment is locally attachable or must
+        # be shipped (reference: owner-based object directory).
+        self.store_id = os.urandom(8).hex()
         self._io_wakeup_r, self._io_wakeup_w = multiprocessing.Pipe(False)
         self._stopped = False
         self._extra_workers = 0
@@ -296,8 +361,21 @@ class Runtime:
             os.path.join(self._sock_dir, "worker.sock"), "AF_UNIX",
             backlog=512, authkey=self._authkey)
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="ray_tpu-accept")
+            target=self._accept_loop, args=(self._listener,), daemon=True,
+            name="ray_tpu-accept")
         self._accept_thread.start()
+        # TCP listener: node agents and their workers dial in here
+        # (reference: the GCS + raylet gRPC ports).  Head-host-local
+        # workers keep the unix socket.
+        self._tcp_listener = multiprocessing.connection.Listener(
+            (config.listen_host, 0), "AF_INET", backlog=512,
+            authkey=self._authkey)
+        self.tcp_address = protocol.format_address(
+            self._tcp_listener.address)
+        self._tcp_accept_thread = threading.Thread(
+            target=self._accept_loop, args=(self._tcp_listener,),
+            daemon=True, name="ray_tpu-accept-tcp")
+        self._tcp_accept_thread.start()
 
         head_resources = {"CPU": float(num_cpus if num_cpus is not None
                                        else os.cpu_count() or 1)}
@@ -318,8 +396,12 @@ class Runtime:
         atexit.register(self.shutdown)
 
     # ------------------------------------------------------------- nodes --
-    def _add_node_locked(self, resources, labels=None) -> NodeState:
-        node = NodeState(NodeID.from_random(), resources, labels)
+    def _add_node_locked(self, resources, labels=None, agent=None,
+                         store_id=None) -> NodeState:
+        node = NodeState(NodeID.from_random(), resources, labels,
+                         agent=agent,
+                         store_id=(self.store_id if store_id is None
+                                   else store_id))
         self.nodes[node.node_id] = node
         self.node_order.append(node.node_id)
         return node
@@ -348,6 +430,19 @@ class Runtime:
                 return
             node.alive = False
             workers = list(node.all_workers.values())
+            agent = node.agent
+        if agent is not None and not agent.dead:
+            # Out-of-process node: the agent terminates its workers and
+            # exits; conn EOFs drive the death handling.
+            try:
+                agent.send(("shutdown",))
+            except Exception:
+                pass
+            try:
+                agent.conn.close()
+            except Exception:
+                pass
+            self._on_agent_death(agent)
         for w in workers:
             try:
                 w.proc.terminate()
@@ -416,8 +511,15 @@ class Runtime:
         if st.refcount() <= 0 and not st.futures and not st.waiters:
             self.objects.pop(oid, None)
             if st.descr is not None and st.descr[0] == protocol.SHM:
-                self.shm.unlink(st.descr[1], st.descr[2],
-                                reusable=not st.shipped)
+                home = st.descr[3] if len(st.descr) > 3 else self.store_id
+                if home == self.store_id:
+                    self.shm.unlink(st.descr[1], st.descr[2],
+                                    reusable=not st.shipped)
+                else:
+                    agent = self._agents.get(home)
+                    if agent is not None and not agent.dead:
+                        agent.send(("unlink_segment", st.descr[1],
+                                    st.descr[2]))
             if st.segment is not None:
                 st.segment.close()
             if st.nested_ids:
@@ -433,7 +535,7 @@ class Runtime:
         if res[0] == "inline":
             return (protocol.INLINE, res[1])
         name, size = self.shm.create_from_parts(object_id, res[1], res[2])
-        return (protocol.SHM, name, size)
+        return (protocol.SHM, name, size, self.store_id)
 
     def put_object(self, value):
         from ray_tpu._private.object_ref import ObjectRef
@@ -512,6 +614,18 @@ class Runtime:
         kind = descr[0]
         if kind == protocol.INLINE:
             value = serialization.loads_inline(descr[1])
+        elif kind == protocol.PARTS:
+            value = serialization.loads(descr[1], descr[2])
+        elif kind == protocol.SHM and len(descr) > 3 \
+                and descr[3] != self.store_id:
+            # Segment lives in another node's store: ship its parts
+            # (reference: ObjectManager::Pull via the owner's directory).
+            meta, bufs = self._fetch_parts(descr)
+            value = serialization.loads(meta, bufs)
+            with self.lock:
+                st2 = self.objects.get(oid)
+                if st2 is not None:
+                    st2.shipped = True
         elif kind == protocol.SHM:
             seg = self.shm.attach(descr[1])
             value = seg.deserialize()
@@ -527,6 +641,127 @@ class Runtime:
                 st2.value = value
                 st2.has_value = True
         return value
+
+    def _register_lineage_locked(self, spec: dict):
+        if not self.config.lineage_enabled:
+            return
+        if "actor_id" in spec or spec.get("num_returns", 0) <= 0:
+            return  # actor methods have side effects; no re-execution
+        tid = TaskID(spec["task_id"])
+        self.lineage[spec["task_id"]] = {
+            "spec": spec,
+            "alive": {tid.object_id(i).binary()
+                      for i in range(spec["num_returns"])},
+        }
+
+    def _release_lineage_for_locked(self, oid: ObjectID):
+        entry = self.lineage.get(oid.task_id().binary())
+        if entry is None:
+            return
+        entry["alive"].discard(oid.binary())
+        if not entry["alive"]:
+            spec = entry["spec"]
+            self.lineage.pop(spec["task_id"], None)
+            # Large by-value args were kept alive for re-execution; the
+            # last return object is gone, so release them now.
+            for name, size in spec.get("tmp_segments", []):
+                self.shm.unlink(name, size)
+            spec["tmp_segments"] = []
+
+    def _store_is_dead(self, store_hex: str) -> bool:
+        if store_hex == self.store_id:
+            return False
+        agent = self._agents.get(store_hex)
+        return agent is None or agent.dead
+
+    def _try_recover_locked(self, oid: ObjectID) -> bool:
+        """Queue re-execution of ``oid``'s creating task (reference:
+        ObjectRecoveryManager::RecoverObject).  Returns False if no lineage
+        exists (puts, actor results, released lineage)."""
+        entry = self.lineage.get(oid.task_id().binary())
+        if entry is None:
+            return False
+        spec = entry["spec"]
+        if spec["task_id"] in self.tasks:
+            return True  # already re-executing
+        tid = TaskID(spec["task_id"])
+        for i in range(spec["num_returns"]):
+            oid_i = tid.object_id(i)
+            sti = self.objects.get(oid_i)
+            if sti is None:
+                sti = self.objects[oid_i] = ObjectState(tid)
+            elif sti.status != PENDING:
+                sti.status = PENDING
+                sti.descr = None
+                sti.value = None
+                sti.has_value = False
+                sti.segment = None
+                sti.shipped = False
+        req = spec.get("resources") or {"CPU": 1.0}
+        rec = TaskRecord(spec, req,
+                         spec.get("max_retries",
+                                  self.config.default_max_retries))
+        _apply_strategy(rec, spec)
+        self.tasks[spec["task_id"]] = rec
+        # Recursively recover lost dependencies first: a dep whose segment
+        # store died must be rebuilt before this task can run on it.
+        for a in spec.get("args", []):
+            if isinstance(a, tuple) and a and a[0] == "ref":
+                dep = ObjectID(a[1])
+                dst = self.objects.get(dep)
+                if (dst is None
+                        or (dst.status == READY and dst.descr is not None
+                            and dst.descr[0] == protocol.SHM
+                            and len(dst.descr) > 3
+                            and self._store_is_dead(dst.descr[3]))):
+                    self._try_recover_locked(dep)
+        self._resolve_deps_locked(rec)
+        if rec.deps_pending == 0:
+            self._enqueue_pending_locked(rec)
+            self._dispatch_locked()
+        self.task_events.append(
+            {"task_id": spec["task_id"].hex(), "name": spec.get("name"),
+             "state": "RECONSTRUCTING", "time": time.time()})
+        return True
+
+    def _recover_and_wait(self, oid: ObjectID, timeout=60.0) -> bool:
+        """Trigger lineage recovery and block until the object is READY
+        again.  Call WITHOUT the runtime lock."""
+        ev = threading.Event()
+        with self.lock:
+            if not self._try_recover_locked(oid):
+                return False
+            st = self.objects.get(oid)
+            if st is None:
+                return False
+            if st.status != PENDING:
+                return st.status == READY
+            st.waiters.append(lambda _oid: ev.set())
+        if not ev.wait(timeout):
+            return False
+        with self.lock:
+            st = self.objects.get(oid)
+            return st is not None and st.status == READY
+
+    def _fetch_parts(self, descr):
+        """Serialized (meta, buffers) of a SHM descriptor, shipping across
+        stores when the segment is not locally attachable.  Blocking: call
+        without the runtime lock held."""
+        home = descr[3] if len(descr) > 3 else self.store_id
+        if home == self.store_id:
+            seg = self.shm.attach(descr[1])
+            try:
+                meta, bufs = seg.raw_parts()
+                return bytes(meta), [bytes(b) for b in bufs]
+            finally:
+                seg.close()
+        with self.lock:
+            agent = self._agents.get(home)
+        if agent is None or agent.dead:
+            raise exc.ObjectLostError(
+                f"object store {home} is gone (node died); segment "
+                f"{descr[1]} unrecoverable")
+        return agent.request_segment(descr[1])
 
     def get_objects(self, refs, timeout=None):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -623,6 +858,7 @@ class Runtime:
                 # atomically with submission).
                 st.local_refs += 1
             self.tasks[spec["task_id"]] = rec
+            self._register_lineage_locked(spec)
             self._pin_nested_locked(spec.get("nested_refs", []))
             self._resolve_deps_locked(rec)
             if "actor_id" in spec:
@@ -797,6 +1033,9 @@ class Runtime:
         import sys
 
         worker_id = WorkerID.from_random()
+        if node.agent is not None:
+            return self._spawn_worker_via_agent(node, env_key, rec,
+                                                tpu_chips, worker_id)
         env = dict(os.environ)
         if rec is not None:
             env.update(
@@ -833,6 +1072,7 @@ class Runtime:
             "RAY_TPU_NODE_ID": node.node_id.hex(),
             "RAY_TPU_JOB_ID": self.job_id.hex(),
         })
+        env["RAY_TPU_STORE_ID"] = self.store_id
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env, cwd=pkg_root)
@@ -841,10 +1081,40 @@ class Runtime:
         self._pending_workers[worker_id.hex()] = w
         return w
 
-    def _accept_loop(self):
+    def _spawn_worker_via_agent(self, node: NodeState, env_key: str,
+                                rec, tpu_chips, worker_id) -> WorkerHandle:
+        """Lease a worker on an out-of-process node: the agent forks it
+        there; the worker dials our TCP listener directly (reference:
+        raylet WorkerPool::StartWorkerProcess, worker_pool.h:156)."""
+        overrides = {}
+        if rec is not None:
+            overrides.update(
+                (rec.spec.get("runtime_env") or {}).get("env_vars", {}))
+        if tpu_chips:
+            overrides["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
+            overrides["TPU_CHIPS_PER_PROCESS_BOUNDS"] = \
+                f"1,1,{len(tpu_chips)}"
+        else:
+            overrides["JAX_PLATFORMS"] = "cpu"
+        overrides.update({
+            "RAY_TPU_WORKER_ID": worker_id.hex(),
+            "RAY_TPU_ADDRESS": self.tcp_address,
+            "RAY_TPU_AUTHKEY": self._authkey.hex(),
+            "RAY_TPU_SESSION": self.session_id,
+            "RAY_TPU_MAX_INLINE": str(self.config.max_inline_object_size),
+            "RAY_TPU_NODE_ID": node.node_id.hex(),
+            "RAY_TPU_JOB_ID": self.job_id.hex(),
+        })
+        w = WorkerHandle(worker_id, None, None, node, env_key, tpu_chips)
+        node.all_workers[id(w)] = w
+        self._pending_workers[worker_id.hex()] = w
+        node.agent.send(("spawn_worker", worker_id.hex(), overrides))
+        return w
+
+    def _accept_loop(self, listener):
         while not self._stopped:
             try:
-                conn = self._listener.accept()
+                conn = listener.accept()
             except (OSError, EOFError, multiprocessing.AuthenticationError):
                 if self._stopped:
                     return
@@ -852,6 +1122,9 @@ class Runtime:
             try:
                 msg = protocol.recv(conn)
             except (EOFError, OSError):
+                continue
+            if msg[0] == "agent_ready":
+                self._register_agent(conn, msg[1])
                 continue
             if msg[0] != "ready":
                 conn.close()
@@ -866,6 +1139,27 @@ class Runtime:
                 w.ready.set()
                 self._conn_to_worker[conn] = w
             self._io_wakeup_w.send_bytes(b"w")  # re-poll with the new conn
+
+    def _register_agent(self, conn, info: dict):
+        """A node agent dialed in: add its node to the cluster (reference:
+        NodeManager::RegisterGcs, gcs_node_manager.h:41 HandleRegisterNode).
+        """
+        agent = AgentHandle(conn, info["store_id"], info["shm_dir"], info)
+        resources = dict(info.get("resources") or {"CPU": 1.0})
+        resources.setdefault("memory", float(2 ** 33))
+        with self.lock:
+            node = self._add_node_locked(resources,
+                                         labels=info.get("labels"),
+                                         agent=agent,
+                                         store_id=info["store_id"])
+            agent.node = node
+            self._agents[agent.store_id] = agent
+            self._conn_to_agent[conn] = agent
+        protocol.send(conn, ("agent_ack", node.node_id.hex(),
+                             self.session_id))
+        with self.lock:
+            self._dispatch_locked()
+        self._io_wakeup_w.send_bytes(b"w")
 
     def _send_task(self, worker: WorkerHandle, rec: TaskRecord):
         spec = rec.spec
@@ -1188,10 +1482,23 @@ class Runtime:
         while not self._stopped:
             with self.lock:
                 conns = list(self._conn_to_worker.keys())
+                conns.extend(self._conn_to_agent.keys())
             conns.append(self._io_wakeup_r)
             try:
                 ready = multiprocessing.connection.wait(conns, timeout=1.0)
             except OSError:
+                # A conn was closed out from under the poll (e.g. node
+                # death handling): drop the stale fds or wait() raises
+                # forever.
+                with self.lock:
+                    stale_w = [(c, w) for c, w in
+                               self._conn_to_worker.items() if c.closed]
+                    stale_a = [(c, a) for c, a in
+                               self._conn_to_agent.items() if c.closed]
+                for _, w in stale_w:
+                    self._on_worker_death(w)
+                for _, a in stale_a:
+                    self._on_agent_death(a)
                 continue
             for conn in ready:
                 if conn is self._io_wakeup_r:
@@ -1199,6 +1506,19 @@ class Runtime:
                         conn.recv_bytes()
                     except (EOFError, OSError):
                         pass
+                    continue
+                agent = self._conn_to_agent.get(conn)
+                if agent is not None:
+                    try:
+                        msg = protocol.recv(conn)
+                    except (EOFError, OSError):
+                        self._on_agent_death(agent)
+                        continue
+                    try:
+                        self._handle_agent_msg(agent, msg)
+                    except Exception:
+                        import traceback
+                        traceback.print_exc()
                     continue
                 worker = self._conn_to_worker.get(conn)
                 if worker is None:
@@ -1214,6 +1534,38 @@ class Runtime:
                     import traceback
                     traceback.print_exc()
 
+    def _handle_agent_msg(self, agent: AgentHandle, msg: tuple):
+        if msg[0] == "segment":
+            agent.deliver(msg[1], msg[2], msg[3])
+
+    def _on_agent_death(self, agent: AgentHandle):
+        """Node agent connection dropped: the node is gone (reference: GCS
+        health-check failure -> node death broadcast,
+        gcs_health_check_manager.h:39)."""
+        with self.lock:
+            if agent.dead:
+                return
+            agent.dead = True
+            self._conn_to_agent.pop(agent.conn, None)
+            self._agents.pop(agent.store_id, None)
+            node = agent.node
+            if node is not None:
+                node.alive = False
+            workers = list(node.all_workers.values()) if node else []
+        agent.fail_all(exc.RayTpuError("node agent died"))
+        # Its workers are unreachable (and die with the agent when it exits
+        # cleanly).  Drive the death path directly — a closed conn makes
+        # connection.wait() raise rather than report EOF, so waiting on the
+        # IO loop to notice would spin.
+        for w in workers:
+            conn = w.conn
+            self._on_worker_death(w)
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
     def _handle_worker_msg(self, worker: WorkerHandle, msg: tuple):
         tag = msg[0]
         if tag == "ready":
@@ -1228,6 +1580,24 @@ class Runtime:
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
         elif tag == "get":
             self._on_worker_get(worker, msg[1], msg[2], msg[3])
+        elif tag == "getparts":
+            # Worker holds a descriptor for a segment in another node's
+            # store: ship the serialized parts.  Fetch may block on a
+            # remote agent, so it runs off the IO thread.
+            rid, descr = msg[1], msg[2]
+
+            def fetch_and_reply(worker=worker, rid=rid, descr=descr):
+                try:
+                    meta, bufs = self._fetch_parts(descr)
+                    worker.send(("obj", rid, True,
+                                 (protocol.PARTS, meta, bufs)))
+                except BaseException as e:  # noqa: BLE001
+                    err = serialization.dumps_inline(
+                        e if isinstance(e, exc.RayTpuError)
+                        else exc.ObjectLostError(repr(e)))
+                    worker.send(("obj", rid, False, (protocol.ERROR, err)))
+
+            threading.Thread(target=fetch_and_reply, daemon=True).start()
         elif tag == "wait":
             _, rid, id_bins, num_returns, timeout = msg
             from ray_tpu._private.object_ref import ObjectRef
@@ -1361,6 +1731,7 @@ class Runtime:
                 fid = spec["func_id"]
                 self.functions.setdefault(fid, spec.pop("func_payload"))
             self.tasks[spec["task_id"]] = rec
+            self._register_lineage_locked(spec)
             self._pin_nested_locked(spec.get("nested_refs", []))
             self._resolve_deps_locked(rec)
             if "actor_id" in spec:
@@ -1618,17 +1989,27 @@ class Runtime:
                         node.idle_workers[key] = keep
                 # Workers that died (or hung) before dialing back.
                 for wid, w in list(self._pending_workers.items()):
-                    crashed = w.proc.poll() is not None
+                    # Agent-spawned workers have no local proc handle;
+                    # their crash shows as a start timeout.
+                    crashed = (w.proc is not None
+                               and w.proc.poll() is not None)
                     timed_out = (now - w.spawned_at >
                                  self.config.worker_start_timeout_s)
                     if crashed or timed_out:
                         self._pending_workers.pop(wid, None)
                         dead_pending.append(w)
             for w in dead_pending:
-                try:
-                    w.proc.terminate()
-                except Exception:
-                    pass
+                if w.proc is not None:
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
+                elif w.node.agent is not None and not w.node.agent.dead:
+                    try:
+                        w.node.agent.send(
+                            ("kill_worker", w.worker_id.hex()))
+                    except Exception:
+                        pass
                 self._on_worker_death(w)
 
     # ----------------------------------------------------------- KV store --
@@ -1723,8 +2104,15 @@ class Runtime:
                     pass
         try:
             self._listener.close()
+            self._tcp_listener.close()
         except Exception:
             pass
+        for agent in list(self._agents.values()):
+            try:
+                agent.send(("shutdown",))
+                agent.conn.close()
+            except Exception:
+                pass
         self.shm.cleanup()
         try:
             self._io_wakeup_w.send_bytes(b"x")

@@ -62,6 +62,19 @@ class Segment:
         buffers = [view[o : o + l] for o, l in zip(offsets, lengths)]
         return serialization.loads(payload, buffers)
 
+    def raw_parts(self):
+        """(meta, buffer views) WITHOUT deserializing — the wire form for
+        cross-node object transfer (the head ships these to another store's
+        consumer; reference: object_manager.h:206 chunk push/pull)."""
+        magic, meta_len = _HEADER.unpack_from(self._mm, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"Corrupt shm segment {self.name}")
+        view = memoryview(self._mm)
+        table = bytes(view[_HEADER.size: _HEADER.size + meta_len])
+        offsets, lengths, payload = serialization.loads_inline(table)
+        buffers = [view[o: o + l] for o, l in zip(offsets, lengths)]
+        return payload, buffers
+
     def close(self):
         # The deserialized value may hold views into the mapping; mmap.close
         # will fail with BufferError if so — let the GC of those arrays

@@ -61,6 +61,9 @@ class _WorkerRuntime:
         self.worker_id_hex = ""
         self.node_id_hex = ""
         self.job_id_hex = ""
+        # Which host object store this worker can mmap directly; SHM
+        # descriptors from other stores are shipped as parts via the driver.
+        self.store_id = os.environ.get("RAY_TPU_STORE_ID", "")
         self.assigned_resources: Dict[str, float] = {}
         self.tpu_chips: list = []
         # Objects fetched or created locally, cached: id -> value (LRU).
@@ -118,7 +121,18 @@ class _WorkerRuntime:
         kind = descr[0]
         if kind == protocol.INLINE:
             return serialization.loads_inline(descr[1])
+        if kind == protocol.PARTS:
+            return serialization.loads(descr[1], descr[2])
         if kind == protocol.SHM:
+            if len(descr) > 3 and descr[3] != self.store_id:
+                # Segment homed in another node's store: ask the driver to
+                # ship its serialized parts (reference: ObjectManager pull
+                # through the owner, object_manager.h:206).
+                ok, reply = self._request(
+                    lambda rid: ("getparts", rid, tuple(descr)))
+                if not ok:
+                    raise self.materialize_error(reply)
+                return self.materialize(reply)
             seg = self.shm.attach(descr[1])
             self._segments.append(seg)
             return seg.deserialize()
@@ -133,7 +147,7 @@ class _WorkerRuntime:
         if res[0] == "inline":
             return (protocol.INLINE, res[1])
         name, size = self.shm.create_from_parts(object_id, res[1], res[2])
-        return (protocol.SHM, name, size)
+        return (protocol.SHM, name, size, self.store_id)
 
     # -- runtime accessor API (mirrors driver Runtime) ---------------------
     def add_local_reference(self, object_id: ObjectID):
@@ -362,7 +376,7 @@ def main():
     import time
     from multiprocessing.connection import Client
 
-    address = os.environ["RAY_TPU_ADDRESS"]
+    address = protocol.parse_address(os.environ["RAY_TPU_ADDRESS"])
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     conn = None
     for attempt in range(20):

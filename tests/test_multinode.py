@@ -1,0 +1,136 @@
+"""Out-of-process multi-node cluster tests.
+
+Reference analog: ``python/ray/tests/test_multi_node*.py`` driven by
+``cluster_utils.Cluster`` (``python/ray/cluster_utils.py:99``) — real
+per-node daemons on one machine.  Here each external node is a real
+``node_agent`` subprocess with its own shm store; objects genuinely cannot
+be mmap'd across nodes, so these tests exercise the transfer path
+(``object_manager.h:206`` analog), remote worker spawn (``worker_pool.h:156``
+analog), and node-death recovery.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy as NA,
+)
+
+
+@pytest.fixture
+def cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_num_cpus=2)
+    yield c
+    c.shutdown()
+
+
+@ray.remote
+def _whoami():
+    import ray_tpu
+
+    return ray_tpu.get_runtime_context().node_id
+
+
+@ray.remote
+def _make_array(n):
+    return np.arange(n, dtype=np.int64)
+
+
+@ray.remote
+def _total(x):
+    return int(x.sum())
+
+
+def test_task_runs_on_external_node(cluster):
+    n1 = cluster.add_node(num_cpus=2, external=True)
+    out = ray.get(
+        _whoami.options(scheduling_strategy=NA(node_id=n1)).remote())
+    assert out == n1
+
+
+def test_object_transfer_head_to_node(cluster):
+    n1 = cluster.add_node(num_cpus=2, external=True)
+    big = np.arange(3_000_000, dtype=np.int64)
+    ref = ray.put(big)  # lives in the head store
+    s = ray.get(
+        _total.options(scheduling_strategy=NA(node_id=n1)).remote(ref))
+    assert s == int(big.sum())
+
+
+def test_object_transfer_node_to_head_and_cross_node(cluster):
+    n1 = cluster.add_node(num_cpus=2, external=True)
+    n2 = cluster.add_node(num_cpus=2, external=True)
+    ref = _make_array.options(
+        scheduling_strategy=NA(node_id=n1)).remote(5_000_000)
+    got = ray.get(ref)  # node1 store -> head
+    expect = int(np.arange(5_000_000, dtype=np.int64).sum())
+    assert int(got.sum()) == expect
+    # node1 store -> node2 consumer (through the head relay)
+    s = ray.get(
+        _total.options(scheduling_strategy=NA(node_id=n2)).remote(ref))
+    assert s == expect
+
+
+def test_actor_on_external_node(cluster):
+    n1 = cluster.add_node(num_cpus=2, external=True)
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def inc(self):
+            self.x += 1
+            return self.x
+
+        def where(self):
+            import ray_tpu
+
+            return ray_tpu.get_runtime_context().node_id
+
+    a = Counter.options(scheduling_strategy=NA(node_id=n1)).remote()
+    assert ray.get([a.inc.remote() for _ in range(3)]) == [1, 2, 3]
+    assert ray.get(a.where.remote()) == n1
+
+
+def test_agent_death_retries_elsewhere(cluster):
+    n1 = cluster.add_node(num_cpus=2, external=True)
+    big = np.arange(1_000_000, dtype=np.int64)
+    ref = ray.put(big)
+
+    @ray.remote(max_retries=3)
+    def slow_total(x):
+        time.sleep(2.0)
+        return int(x.sum())
+
+    f = slow_total.options(
+        scheduling_strategy=NA(node_id=n1, soft=True)).remote(ref)
+    time.sleep(0.8)
+    cluster.kill_agent(n1)  # SIGKILL: no graceful shutdown
+    assert ray.get(f, timeout=60) == int(big.sum())
+    # the node is marked dead
+    dead = [n for n in cluster.rt.list_nodes() if n["node_id"] == n1]
+    assert dead and not dead[0]["alive"]
+
+
+def test_node_local_objects_lost_on_agent_death(cluster):
+    n1 = cluster.add_node(num_cpus=2, external=True)
+    ref = _make_array.options(
+        scheduling_strategy=NA(node_id=n1)).remote(2_000_000)
+    ray.wait([ref], num_returns=1, timeout=30)
+    cluster.kill_agent(n1)
+    time.sleep(0.5)
+    # The segment is gone with the node's store; without lineage
+    # reconstruction this surfaces as ObjectLostError.  (Lineage recovery
+    # turns this into a re-execution — covered in test_lineage.)
+    try:
+        got = ray.get(ref, timeout=30)
+        assert int(got.sum()) == int(
+            np.arange(2_000_000, dtype=np.int64).sum())
+    except ray.exceptions.ObjectLostError:
+        pass
