@@ -62,6 +62,11 @@ class Config:
     # Task retry default (reference: max_retries=3 for normal tasks).
     default_max_retries: int = 3
 
+    # Tasks pipelined onto one leased worker before a new worker is leased
+    # (reference: max_tasks_in_flight_per_worker in
+    # direct_task_transport.h:75 — kills the per-task result round trip).
+    max_tasks_in_flight_per_worker: int = 10
+
     # Health-check cadence for worker processes (reference: GCS pull-based
     # health checks, gcs_health_check_manager.h:39).
     health_check_period_s: float = 5.0

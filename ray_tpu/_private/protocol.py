@@ -14,17 +14,21 @@ driver → worker
   ("exec",   task: dict)            run a task / actor method
   ("create_actor", spec: dict)      instantiate actor class on this worker
   ("func",   func_id, payload)      function/class definition (cloudpickle)
-  ("obj",    req_id, ok, descr)     reply to a worker "get"
-  ("submitted", req_id)             ack of a nested "submit"
+  ("obj",    req_id, ok, descr)     reply to a worker "get"/"getparts"
+  ("mgot",   req_id, [(ok, descr)]) reply to a batched "mget"
+  ("free_segment", name, size, reusable)  owner freed a segment this worker
+                                    created; pool pages iff reusable
   ("kill",   )                      graceful shutdown
 worker → driver
   ("ready",  worker_id_hex, pid)
   ("result", task_id_bytes, ok, returns: list[Descr], meta: dict)
   ("get",    req_id, object_id_bytes, timeout)
-  ("need_func", func_id, task: dict)  exec bounced: definition not cached
-  ("submit", spec: dict)            nested task submission
-  ("put",    object_id_bytes, descr)
+  ("mget",   req_id, [object_id_bytes], timeout)   batched get
+  ("submit", 0, spec: dict)         nested task submission (fire-and-forget;
+                                    per-conn FIFO makes later uses safe)
+  ("put",    object_id_bytes, descr, nested_ids)
   ("addref", object_id_bytes) / ("decref", object_id_bytes)
+  ("decref_batch", [object_id_bytes])   buffered ref drops
   ("blocked", task_id_bytes) / ("unblocked", task_id_bytes)
   ("actor_exit", actor_id_bytes, ok, error_descr)
 

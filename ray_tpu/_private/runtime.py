@@ -57,7 +57,7 @@ class ObjectState:
     __slots__ = (
         "status", "descr", "local_refs", "worker_refs", "pins",
         "futures", "waiters", "task_id", "value", "has_value", "segment",
-        "nested_ids", "shipped",
+        "nested_ids", "shipped", "creator",
     )
 
     def __init__(self, task_id: Optional[TaskID] = None):
@@ -72,6 +72,10 @@ class ObjectState:
         self.value = None
         self.has_value = False
         self.segment = None
+        # WorkerHandle whose process created this object's shm segment (None
+        # when the driver did).  Frees are routed back to the creator so its
+        # store can pool the pages for in-place reuse.
+        self.creator = None
         # True once this object's descriptor left the process (a worker may
         # hold zero-copy views over the segment) or was mapped locally —
         # such segments must not be pooled for in-place reuse.
@@ -152,8 +156,10 @@ class ActorState:
 class WorkerHandle:
     __slots__ = (
         "worker_id", "conn", "proc", "node", "send_lock", "env_key",
-        "current", "actor_id", "tpu_chips", "idle_since", "released",
+        "inflight", "actor_id", "tpu_chips", "idle_since", "released",
         "ready", "dead", "outbox", "spawned_at",
+        "lease_key", "lease_req", "lease_pg", "blocked",
+        "pending_force_kill",
     )
 
     def __init__(self, worker_id, conn, proc, node, env_key, tpu_chips):
@@ -163,15 +169,27 @@ class WorkerHandle:
         self.node = node
         self.send_lock = threading.Lock()
         self.env_key = env_key
-        self.current: Optional[TaskRecord] = None
+        # Tasks pushed to this worker and not yet resulted, in send order
+        # (the worker executes its queue FIFO).  Reference: task pipelining
+        # onto leased workers, direct_task_transport.h:75.
+        self.inflight: Dict[bytes, TaskRecord] = {}
         self.actor_id: Optional[bytes] = None
         self.tpu_chips = tpu_chips or []
         self.idle_since = time.monotonic()
         self.released = False  # resources released while blocked in get
+        self.blocked = False    # inside ray.get: no new pipelined tasks
         self.ready = threading.Event()
         self.dead = False
         self.outbox: List[tuple] = []
         self.spawned_at = time.monotonic()
+        # Lease state: while leased, the worker holds lease_req resources on
+        # its node (or lease_pg's bundle) and serves one scheduling class.
+        self.lease_key: Optional[tuple] = None
+        self.lease_req: Optional[Dict[str, float]] = None
+        self.lease_pg: Optional[tuple] = None  # (pg_id, bundle_index)
+        # Set by force-cancel: victim task id; the proc is terminated only
+        # after a steal pass rescues the other pipelined tasks.
+        self.pending_force_kill: Optional[bytes] = None
 
     def send(self, msg):
         with self.send_lock:
@@ -329,6 +347,10 @@ class Runtime:
         # (Reference: per-SchedulingKey lease queues in
         # direct_task_transport.h:75 / scheduling classes.)
         self.pending_tasks: Dict[tuple, deque] = {}
+        # Workers currently holding a lease, by scheduling class — the
+        # pipelining pool (reference: the submitter's per-SchedulingKey
+        # worker leases, direct_task_transport.h:75).
+        self.leased_workers: Dict[tuple, List[WorkerHandle]] = {}
         # Lineage: creating-task spec kept while any of its return objects
         # is alive, so a lost object can be rebuilt by re-execution
         # (reference: object_recovery_manager.h:41, task_manager.h:174
@@ -346,7 +368,6 @@ class Runtime:
         # so consumers know whether a segment is locally attachable or must
         # be shipped (reference: owner-based object directory).
         self.store_id = os.urandom(8).hex()
-        self._io_wakeup_r, self._io_wakeup_w = multiprocessing.Pipe(False)
         self._stopped = False
         self._extra_workers = 0
 
@@ -387,9 +408,6 @@ class Runtime:
         self.head_node = self._add_node_locked(head_resources,
                                                labels={"head": "1"})
 
-        self._io_thread = threading.Thread(
-            target=self._io_loop, daemon=True, name="ray_tpu-io")
-        self._io_thread.start()
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True, name="ray_tpu-reaper")
         self._reaper.start()
@@ -512,19 +530,33 @@ class Runtime:
             self.objects.pop(oid, None)
             if st.descr is not None and st.descr[0] == protocol.SHM:
                 home = st.descr[3] if len(st.descr) > 3 else self.store_id
-                if home == self.store_id:
-                    self.shm.unlink(st.descr[1], st.descr[2],
-                                    reusable=not st.shipped)
-                else:
-                    agent = self._agents.get(home)
-                    if agent is not None and not agent.dead:
-                        agent.send(("unlink_segment", st.descr[1],
-                                    st.descr[2]))
+                cw = st.creator
+                if cw is not None and not cw.dead:
+                    # A worker's store created the segment: route the free
+                    # there so its pages can be pooled for in-place reuse
+                    # (shipped segments may be mapped elsewhere — the worker
+                    # then just closes + unlinks).
+                    try:
+                        cw.send(("free_segment", st.descr[1],
+                                 st.descr[2], not st.shipped))
+                    except Exception:
+                        cw = None  # fall through to store-based free
+                if cw is None or cw.dead:
+                    if home == self.store_id:
+                        self.shm.unlink(st.descr[1], st.descr[2],
+                                        reusable=(not st.shipped
+                                                  and st.creator is None))
+                    else:
+                        agent = self._agents.get(home)
+                        if agent is not None and not agent.dead:
+                            agent.send(("unlink_segment", st.descr[1],
+                                        st.descr[2]))
             if st.segment is not None:
                 st.segment.close()
             if st.nested_ids:
                 nested, st.nested_ids = st.nested_ids, []
                 self._unpin_nested_locked(nested)
+            self._release_lineage_for_locked(oid)
 
     # ------------------------------------------------------------ objects --
     def serialize_value(self, value, object_id: ObjectID):
@@ -559,12 +591,16 @@ class Runtime:
             self._pin_nested_locked(nested)
         return ObjectRef(oid, _register=False)
 
-    def _complete_object_locked(self, oid: ObjectID, descr, ok: bool):
+    def _complete_object_locked(self, oid: ObjectID, descr, ok: bool,
+                                creator=None):
         st = self.objects.get(oid)
         if st is None:
             st = self.objects[oid] = ObjectState()
         st.status = READY if ok else ERRORED
         st.descr = descr
+        if creator is not None and descr is not None \
+                and descr[0] == protocol.SHM:
+            st.creator = creator
         futures, st.futures = st.futures, []
         waiters, st.waiters = st.waiters, []
         for f in futures:
@@ -597,7 +633,7 @@ class Runtime:
         inner.add_done_callback(_chain)
         return outer
 
-    def _materialize(self, oid: ObjectID):
+    def _materialize(self, oid: ObjectID, _recovering=False):
         with self.lock:
             st = self.objects.get(oid)
             if st is None:
@@ -620,14 +656,28 @@ class Runtime:
                 and descr[3] != self.store_id:
             # Segment lives in another node's store: ship its parts
             # (reference: ObjectManager::Pull via the owner's directory).
-            meta, bufs = self._fetch_parts(descr)
+            try:
+                meta, bufs = self._fetch_parts(descr)
+            except exc.ObjectLostError:
+                # Home store is gone: rebuild by lineage re-execution
+                # (reference: object_recovery_manager.h:41).
+                if _recovering or not self._recover_and_wait(oid):
+                    raise
+                return self._materialize(oid, _recovering=True)
             value = serialization.loads(meta, bufs)
             with self.lock:
                 st2 = self.objects.get(oid)
                 if st2 is not None:
                     st2.shipped = True
         elif kind == protocol.SHM:
-            seg = self.shm.attach(descr[1])
+            try:
+                seg = self.shm.attach(descr[1])
+            except FileNotFoundError:
+                if _recovering or not self._recover_and_wait(oid):
+                    raise exc.ObjectLostError(
+                        f"Object {oid.hex()}: segment {descr[1]} missing "
+                        f"and not recoverable")
+                return self._materialize(oid, _recovering=True)
             value = seg.deserialize()
             with self.lock:
                 st2 = self.objects.get(oid)
@@ -648,25 +698,34 @@ class Runtime:
         if "actor_id" in spec or spec.get("num_returns", 0) <= 0:
             return  # actor methods have side effects; no re-execution
         tid = TaskID(spec["task_id"])
-        self.lineage[spec["task_id"]] = {
+        # Keyed by the 12-byte task prefix: an ObjectID carries only the
+        # prefix of its creating TaskID (ids.py), so recovery must be able
+        # to go oid -> lineage without the full 16-byte task id.
+        self.lineage[spec["task_id"][:12]] = {
             "spec": spec,
             "alive": {tid.object_id(i).binary()
                       for i in range(spec["num_returns"])},
         }
 
     def _release_lineage_for_locked(self, oid: ObjectID):
-        entry = self.lineage.get(oid.task_id().binary())
+        entry = self.lineage.get(oid.task_prefix())
         if entry is None:
             return
         entry["alive"].discard(oid.binary())
         if not entry["alive"]:
             spec = entry["spec"]
-            self.lineage.pop(spec["task_id"], None)
-            # Large by-value args were kept alive for re-execution; the
-            # last return object is gone, so release them now.
-            for name, size in spec.get("tmp_segments", []):
-                self.shm.unlink(name, size)
-            spec["tmp_segments"] = []
+            self.lineage.pop(spec["task_id"][:12], None)
+            # The last return object is gone: nothing can ask for
+            # re-execution anymore, so the nested-ref pins and by-value arg
+            # segments held for it are released now.
+            self._release_spec_resources_locked(spec)
+
+    def _oid_from_segment_name(self, name: str) -> Optional[ObjectID]:
+        """Segment names are rtpu-<session>-<oid hex> (shm_store.py)."""
+        try:
+            return ObjectID(bytes.fromhex(name.rsplit("-", 1)[1]))
+        except Exception:
+            return None
 
     def _store_is_dead(self, store_hex: str) -> bool:
         if store_hex == self.store_id:
@@ -678,7 +737,7 @@ class Runtime:
         """Queue re-execution of ``oid``'s creating task (reference:
         ObjectRecoveryManager::RecoverObject).  Returns False if no lineage
         exists (puts, actor results, released lineage)."""
-        entry = self.lineage.get(oid.task_id().binary())
+        entry = self.lineage.get(oid.task_prefix())
         if entry is None:
             return False
         spec = entry["spec"]
@@ -959,14 +1018,23 @@ class Runtime:
         # rest (node_affinity/spread) the whole tuple keys the class.
         skey = None if strategy and strategy[0] == "placement_group" \
             else repr(strategy)
+        # Actor creations get singleton classes: their worker becomes the
+        # actor, so plain tasks must never pipeline onto its lease.
+        marker = rec.actor_id if rec.is_actor_creation else None
         return (tuple(sorted(rec.requirements.items())),
-                rec.pg_id, rec.bundle_index, skey)
+                rec.pg_id, rec.bundle_index, skey, marker)
 
     def _enqueue_pending_locked(self, rec: "TaskRecord"):
         self.pending_tasks.setdefault(
             self._sched_class(rec), deque()).append(rec)
 
     def _dispatch_locked(self):
+        """Assign queued tasks to workers.  Two-step per scheduling class,
+        mirroring the reference's lease model (direct_task_transport.h:75):
+        first pipeline onto already-leased workers of the class (up to
+        max_tasks_in_flight each — the lease holds the resources, so
+        pipelined tasks cost no extra slots), then lease new workers while
+        resources remain."""
         if self._stopped:
             return
         if self.pending_pgs:
@@ -980,7 +1048,16 @@ class Runtime:
                     continue
                 node = self._pick_node_locked(rec)
                 if node is None:
-                    break   # same class behind it cannot place either
+                    # No free capacity: overflow onto existing leases
+                    # (pipelining) rather than stall the class.  Fresh
+                    # capacity is preferred so a long task can't head-of-
+                    # line-block a short one while CPUs sit idle.
+                    worker = self._find_pipelinable_worker_locked(key)
+                    if worker is None:
+                        break   # same class behind it cannot place either
+                    q.popleft()
+                    self._assign_to_worker_locked(worker, rec)
+                    continue
                 use_pg = rec.pg_id is not None
                 if use_pg:
                     pg = self.placement_groups.get(rec.pg_id)
@@ -1004,12 +1081,85 @@ class Runtime:
                 q.popleft()
                 rec.node = node
                 worker = self._lease_worker_locked(node, rec, tpu_chips)
-                rec.worker = worker
-                rec.dispatched = True
-                worker.current = rec
-                self._send_task(worker, rec)
+                worker.lease_req = dict(rec.requirements)
+                worker.lease_pg = ((rec.pg_id, rec.bundle_index or 0)
+                                   if use_pg else None)
+                # TPU workers are dedicated + retired after their task, and
+                # actor-creation workers become the actor: neither joins the
+                # pipelining pool.
+                if not tpu_chips and not rec.is_actor_creation:
+                    worker.lease_key = key
+                    self.leased_workers.setdefault(key, []).append(worker)
+                self._assign_to_worker_locked(worker, rec)
             if not q:
                 self.pending_tasks.pop(key, None)
+
+    def _find_pipelinable_worker_locked(
+            self, key: tuple) -> Optional[WorkerHandle]:
+        lst = self.leased_workers.get(key)
+        if not lst:
+            return None
+        depth = self.config.max_tasks_in_flight_per_worker
+        best = None
+        for w in lst:
+            if w.dead or w.blocked or w.released or w.actor_id is not None \
+                    or w.pending_force_kill is not None:
+                continue
+            if len(w.inflight) < depth and (
+                    best is None or len(w.inflight) < len(best.inflight)):
+                best = w
+        return best
+
+    def _assign_to_worker_locked(self, worker: WorkerHandle,
+                                 rec: TaskRecord):
+        rec.node = worker.node
+        rec.worker = worker
+        rec.dispatched = True
+        if self._send_task(worker, rec):
+            worker.inflight[rec.spec["task_id"]] = rec
+        elif not worker.inflight:
+            self._end_lease_locked(worker)
+
+    def _end_lease_locked(self, worker: WorkerHandle, reap=False):
+        """Return the worker's lease: release its held resources and pool
+        (or retire) the process (reference: ReturnWorker in
+        direct_task_transport.cc / raylet lease return)."""
+        node = worker.node
+        if worker.lease_key is not None:
+            lst = self.leased_workers.get(worker.lease_key)
+            if lst is not None:
+                try:
+                    lst.remove(worker)
+                except ValueError:
+                    pass
+                if not lst:
+                    self.leased_workers.pop(worker.lease_key, None)
+            worker.lease_key = None
+        if worker.lease_req is not None and node is not None:
+            if not worker.released:
+                if worker.lease_pg is not None:
+                    pg = self.placement_groups.get(worker.lease_pg[0])
+                    if pg is not None and not pg.removed:
+                        self._pg_release_locked(pg, worker.lease_pg[1],
+                                                worker.lease_req)
+                else:
+                    node.release(worker.lease_req)
+        worker.lease_req = None
+        worker.lease_pg = None
+        worker.released = False
+        worker.blocked = False
+        had_tpu = bool(worker.tpu_chips)
+        if had_tpu and node is not None:
+            node.tpu_free.extend(worker.tpu_chips)
+            worker.tpu_chips = []
+        worker.idle_since = time.monotonic()
+        if reap or had_tpu:
+            # TPU workers are dedicated: the chip set is baked into the
+            # process env at spawn, so retire rather than cache.
+            self._kill_worker_locked(worker)
+        elif not worker.dead:
+            worker.node.idle_workers.setdefault(worker.env_key, []).append(
+                worker)
 
     def _env_key_for(self, rec: TaskRecord, tpu_chips) -> str:
         env = rec.spec.get("runtime_env") or {}
@@ -1071,6 +1221,7 @@ class Runtime:
             "RAY_TPU_MAX_INLINE": str(self.config.max_inline_object_size),
             "RAY_TPU_NODE_ID": node.node_id.hex(),
             "RAY_TPU_JOB_ID": self.job_id.hex(),
+            "RAY_TPU_POOL_BYTES": str(self.config.shm_pool_bytes),
         })
         env["RAY_TPU_STORE_ID"] = self.store_id
         proc = subprocess.Popen(
@@ -1104,6 +1255,7 @@ class Runtime:
             "RAY_TPU_MAX_INLINE": str(self.config.max_inline_object_size),
             "RAY_TPU_NODE_ID": node.node_id.hex(),
             "RAY_TPU_JOB_ID": self.job_id.hex(),
+            "RAY_TPU_POOL_BYTES": str(self.config.shm_pool_bytes),
         })
         w = WorkerHandle(worker_id, None, None, node, env_key, tpu_chips)
         node.all_workers[id(w)] = w
@@ -1138,7 +1290,12 @@ class Runtime:
                 w.attach(conn)
                 w.ready.set()
                 self._conn_to_worker[conn] = w
-            self._io_wakeup_w.send_bytes(b"w")  # re-poll with the new conn
+            # One reader thread per connection (replaces the old select
+            # loop): recv/unpickle for different workers runs in parallel,
+            # and a burst from one worker is drained back-to-back instead
+            # of one message per poll cycle.
+            threading.Thread(target=self._worker_reader, args=(conn, w),
+                             daemon=True, name="ray_tpu-rx").start()
 
     def _register_agent(self, conn, info: dict):
         """A node agent dialed in: add its node to the cluster (reference:
@@ -1157,9 +1314,10 @@ class Runtime:
             self._conn_to_agent[conn] = agent
         protocol.send(conn, ("agent_ack", node.node_id.hex(),
                              self.session_id))
+        threading.Thread(target=self._agent_reader, args=(conn, agent),
+                         daemon=True, name="ray_tpu-rx-agent").start()
         with self.lock:
             self._dispatch_locked()
-        self._io_wakeup_w.send_bytes(b"w")
 
     def _send_task(self, worker: WorkerHandle, rec: TaskRecord):
         spec = rec.spec
@@ -1182,14 +1340,14 @@ class Runtime:
             kwargs = {k: subst(a) for k, a in spec.get("kwargs", {}).items()}
         except exc.ObjectLostError as e:
             self._fail_task_locked(rec, e)
-            return
+            return False
         # Dependency errors: fail the task without running it (reference:
         # task_manager.cc marks children failed on dep error).
         for d in list(args) + list(kwargs.values()):
             if d is not None and d[0] == protocol.ERROR:
                 self._fail_task_locked(
                     rec, serialization.loads_inline(d[1]), dispatchable=False)
-                return
+                return False
         msg_task = {
             "task_id": spec["task_id"],
             "func_id": spec.get("func_id"),
@@ -1225,6 +1383,7 @@ class Runtime:
         self.task_events.append(
             {"task_id": spec["task_id"].hex(), "name": spec.get("name"),
              "state": "RUNNING", "time": time.time()})
+        return True
 
     def _fail_task_locked(self, rec: TaskRecord, error: BaseException,
                           dispatchable=True):
@@ -1257,13 +1416,28 @@ class Runtime:
                     if st is not None:
                         st.pins -= 1
                         self._maybe_free_locked(oid, st)
+        # Nested refs and by-value arg segments are kept while lineage holds
+        # the spec — re-execution needs them; _release_lineage_for_locked
+        # frees them when the last return object dies.
+        if spec["task_id"][:12] not in self.lineage:
+            self._release_spec_resources_locked(spec)
+
+    def _release_spec_resources_locked(self, spec: dict):
         # Refs pickled inside argument containers (pinned at submission).
         nested = spec.get("nested_refs", [])
         if nested:
             spec["nested_refs"] = []
             self._unpin_nested_locked(nested)
-        # Ephemeral shm segments that carried large by-value args.
+        # Ephemeral shm segments that carried large by-value args; created
+        # by the submitter's store (driver or worker), freed there.
+        creator = spec.get("_creator_worker")
         for name, size in spec.get("tmp_segments", []):
+            if creator is not None and not creator.dead:
+                try:
+                    creator.send(("free_segment", name, size, False))
+                    continue
+                except Exception:
+                    pass
             self.shm.unlink(name, size)
         spec["tmp_segments"] = []
 
@@ -1334,8 +1508,8 @@ class Runtime:
             rec.dispatched = True
             rec.node = actor.node
             rec.worker = actor.worker
-            actor.inflight[rec.spec["task_id"]] = rec
-            self._send_task(actor.worker, rec)
+            if self._send_task(actor.worker, rec):
+                actor.inflight[rec.spec["task_id"]] = rec
 
     def _fail_actor_queue_locked(self, actor: ActorState,
                                  error: BaseException):
@@ -1477,62 +1651,37 @@ class Runtime:
             self._try_reserve_pgs_locked()
             self._dispatch_locked()
 
-    # ------------------------------------------------------------ IO loop --
-    def _io_loop(self):
+    # ----------------------------------------------------- per-conn readers --
+    def _worker_reader(self, conn, worker: WorkerHandle):
+        """One thread per worker connection (reference: each core worker's
+        gRPC stream is served independently — the single select loop of v1
+        serialized all control traffic through one thread)."""
         while not self._stopped:
-            with self.lock:
-                conns = list(self._conn_to_worker.keys())
-                conns.extend(self._conn_to_agent.keys())
-            conns.append(self._io_wakeup_r)
             try:
-                ready = multiprocessing.connection.wait(conns, timeout=1.0)
-            except OSError:
-                # A conn was closed out from under the poll (e.g. node
-                # death handling): drop the stale fds or wait() raises
-                # forever.
-                with self.lock:
-                    stale_w = [(c, w) for c, w in
-                               self._conn_to_worker.items() if c.closed]
-                    stale_a = [(c, a) for c, a in
-                               self._conn_to_agent.items() if c.closed]
-                for _, w in stale_w:
-                    self._on_worker_death(w)
-                for _, a in stale_a:
-                    self._on_agent_death(a)
-                continue
-            for conn in ready:
-                if conn is self._io_wakeup_r:
-                    try:
-                        conn.recv_bytes()
-                    except (EOFError, OSError):
-                        pass
-                    continue
-                agent = self._conn_to_agent.get(conn)
-                if agent is not None:
-                    try:
-                        msg = protocol.recv(conn)
-                    except (EOFError, OSError):
-                        self._on_agent_death(agent)
-                        continue
-                    try:
-                        self._handle_agent_msg(agent, msg)
-                    except Exception:
-                        import traceback
-                        traceback.print_exc()
-                    continue
-                worker = self._conn_to_worker.get(conn)
-                if worker is None:
-                    continue
-                try:
-                    msg = protocol.recv(conn)
-                except (EOFError, OSError):
-                    self._on_worker_death(worker)
-                    continue
-                try:
-                    self._handle_worker_msg(worker, msg)
-                except Exception:
-                    import traceback
-                    traceback.print_exc()
+                msg = protocol.recv(conn)
+            except (EOFError, OSError, TypeError):
+                # TypeError: conn.close()d out from under a blocked recv
+                # (its handle becomes None mid-read).
+                self._on_worker_death(worker)
+                return
+            try:
+                self._handle_worker_msg(worker, msg)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _agent_reader(self, conn, agent: "AgentHandle"):
+        while not self._stopped:
+            try:
+                msg = protocol.recv(conn)
+            except (EOFError, OSError, TypeError):
+                self._on_agent_death(agent)
+                return
+            try:
+                self._handle_agent_msg(agent, msg)
+            except Exception:
+                import traceback
+                traceback.print_exc()
 
     def _handle_agent_msg(self, agent: AgentHandle, msg: tuple):
         if msg[0] == "segment":
@@ -1588,7 +1737,24 @@ class Runtime:
 
             def fetch_and_reply(worker=worker, rid=rid, descr=descr):
                 try:
-                    meta, bufs = self._fetch_parts(descr)
+                    try:
+                        meta, bufs = self._fetch_parts(descr)
+                    except exc.ObjectLostError:
+                        # Home store died: recover by lineage re-execution,
+                        # then ship the rebuilt object (reference:
+                        # object_recovery_manager.h:41).
+                        oid = self._oid_from_segment_name(descr[1])
+                        if oid is None or not self._recover_and_wait(oid):
+                            raise
+                        with self.lock:
+                            st = self.objects.get(oid)
+                            descr2 = st.descr if st is not None else None
+                        if descr2 is None:
+                            raise
+                        if descr2[0] != protocol.SHM:
+                            worker.send(("obj", rid, True, descr2))
+                            return
+                        meta, bufs = self._fetch_parts(descr2)
                     worker.send(("obj", rid, True,
                                  (protocol.PARTS, meta, bufs)))
                 except BaseException as e:  # noqa: BLE001
@@ -1639,9 +1805,11 @@ class Runtime:
             if count["sent"]:
                 respond()
         elif tag == "submit":
-            _, rid, spec = msg
-            self.submit_task_from_worker(spec)
-            worker.send(("submitted", rid))
+            # Fire-and-forget (reference: PushNormalTask pipelining,
+            # direct_task_transport.cc:568): the worker built its return
+            # refs locally; per-connection FIFO guarantees any later use
+            # of them arrives after this spec.
+            self.submit_task_from_worker(msg[2], submitter=worker)
         elif tag == "create_actor_req":
             _, rid, spec, creation_opts = msg
             try:
@@ -1671,6 +1839,8 @@ class Runtime:
                     st = self.objects[oid] = ObjectState()
                 st.status = READY
                 st.descr = descr
+                if descr[0] == protocol.SHM:
+                    st.creator = worker
                 st.nested_ids = list(nested)
                 self._pin_nested_locked(st.nested_ids)
         elif tag == "addref":
@@ -1687,29 +1857,82 @@ class Runtime:
                 if st is not None:
                     st.worker_refs -= 1
                     self._maybe_free_locked(oid, st)
+        elif tag == "decref_batch":
+            with self.lock:
+                for b in msg[1]:
+                    oid = ObjectID(b)
+                    st = self.objects.get(oid)
+                    if st is not None:
+                        st.worker_refs -= 1
+                        self._maybe_free_locked(oid, st)
+        elif tag == "mget":
+            self._on_worker_mget(worker, msg[1], msg[2], msg[3])
         elif tag == "blocked":
-            # A worker blocked in ray.get releases its CPU slot so the
-            # cluster can make progress (reference: raylet releases
+            # A worker blocked in ray.get releases its lease's CPU slot so
+            # the cluster can make progress (reference: raylet releases
             # resources for blocked workers, node_manager.cc).  PG tasks
             # keep their bundle slot — the gang reservation is the point.
             with self.lock:
-                rec = worker.current
-                if (rec is not None and not worker.released and rec.node
-                        and rec.pg_id is None):
-                    rec.node.release(rec.requirements)
+                worker.blocked = True
+                if (worker.lease_req is not None and not worker.released
+                        and worker.lease_pg is None):
+                    worker.node.release(worker.lease_req)
                     worker.released = True
-                    self._dispatch_locked()
+                # Steal back pipelined-but-unstarted tasks: one of them may
+                # be exactly what this worker's ray.get is waiting for
+                # (head-of-line deadlock; reference: work stealing in
+                # direct_task_transport).  The worker replies "stolen" with
+                # the ids it had not started; those re-dispatch elsewhere.
+                stealable = [tid for tid, r in worker.inflight.items()
+                             if not r.is_actor_creation]
+                if stealable:
+                    worker.send(("steal", 0, stealable))
+                self._dispatch_locked()
         elif tag == "unblocked":
             with self.lock:
-                rec = worker.current
-                if rec is not None and worker.released and rec.node:
-                    rec.node.acquire(rec.requirements)
+                worker.blocked = False
+                if worker.lease_req is not None and worker.released:
+                    worker.node.acquire(worker.lease_req)
                     worker.released = False
+                self._dispatch_locked()
+        elif tag == "stolen":
+            # Tasks the worker relinquished (never started): re-dispatch
+            # elsewhere.  Their results can no longer arrive from it.
+            with self.lock:
+                for tid_bin in msg[2]:
+                    rec = worker.inflight.pop(tid_bin, None)
+                    if rec is None:
+                        continue
+                    if rec.cancelled:
+                        self._fail_task_locked(rec, exc.TaskCancelledError(
+                            rec.spec.get("name", "task")))
+                        continue
+                    rec.dispatched = False
+                    rec.worker = None
+                    self._enqueue_pending_locked(rec)
+                if worker.pending_force_kill is not None:
+                    victim = worker.pending_force_kill
+                    worker.pending_force_kill = None
+                    if victim in worker.inflight:
+                        # Victim already started: kill the process (the
+                        # bystanders were just stolen back).
+                        try:
+                            worker.proc.terminate()
+                        except Exception:
+                            pass
+                if not worker.inflight and worker.lease_req is not None \
+                        and not worker.dead and worker.actor_id is None:
+                    self._end_lease_locked(worker)
+                self._dispatch_locked()
         elif tag == "actor_exit":
             pass
 
-    def submit_task_from_worker(self, spec: dict):
+    def submit_task_from_worker(self, spec: dict, submitter=None):
         """Nested submission: worker-generated task, driver-owned objects."""
+        # The submitting worker's store created any by-value arg segments in
+        # tmp_segments; frees are routed back there (segment-pool reuse).
+        if submitter is not None and spec.get("tmp_segments"):
+            spec["_creator_worker"] = submitter
         req = spec.get("resources") or {"CPU": 1.0}
         rec = TaskRecord(spec, req, spec.get("max_retries",
                                              self.config.default_max_retries))
@@ -1782,6 +2005,64 @@ class Runtime:
                 return
         reply()
 
+    def _on_worker_mget(self, worker: WorkerHandle, rid, id_bins, timeout):
+        """Batched worker get: ONE reply listing (ok, descr) per id, sent
+        when all are complete (or the timeout fires).  Reference:
+        CoreWorker::Get resolves the whole batch (core_worker.cc:1250)."""
+        state = {"left": 0, "done": False, "timer": None}
+
+        def finish_locked():
+            if state["done"]:
+                return
+            state["done"] = True
+            if state["timer"] is not None:
+                state["timer"].cancel()
+            out = []
+            for b in id_bins:
+                st = self.objects.get(ObjectID(b))
+                if st is None:
+                    err = serialization.dumps_inline(exc.ObjectLostError(
+                        f"Object {b.hex()} is unknown or already freed"))
+                    out.append((False, (protocol.ERROR, err)))
+                elif st.status == PENDING:
+                    err = serialization.dumps_inline(exc.GetTimeoutError(
+                        f"Timed out getting {b.hex()} after {timeout}s"))
+                    out.append((False, (protocol.ERROR, err)))
+                else:
+                    st.shipped = True
+                    out.append((st.status == READY, st.descr))
+            try:
+                worker.send(("mgot", rid, out))
+            except Exception:
+                # Requester died mid-wait: never let its broken conn abort
+                # the completing worker's result handling (this runs inside
+                # _complete_object_locked's waiter loop).
+                pass
+
+        with self.lock:
+            pend = [st for b in id_bins
+                    if (st := self.objects.get(ObjectID(b))) is not None
+                    and st.status == PENDING]
+            if not pend:
+                finish_locked()
+                return
+            state["left"] = len(pend)
+
+            def cb(_oid):  # runs under self.lock (RLock) in _complete
+                state["left"] -= 1
+                if state["left"] == 0:
+                    finish_locked()
+
+            for st in pend:
+                st.waiters.append(cb)
+            if timeout is not None:
+                def on_timeout():
+                    with self.lock:
+                        finish_locked()
+                t = state["timer"] = threading.Timer(timeout, on_timeout)
+                t.daemon = True
+                t.start()
+
     def _on_result(self, worker: WorkerHandle, task_id_bin, ok, returns,
                    meta):
         with self.lock:
@@ -1792,7 +2073,7 @@ class Runtime:
             for i, descr in enumerate(returns):
                 item_ok = descr[0] != protocol.ERROR
                 self._complete_object_locked(tid.object_id(i), descr,
-                                             item_ok)
+                                             item_ok, creator=worker)
             self._unpin_task_deps_locked(rec)
             self.task_events.append(
                 {"task_id": task_id_bin.hex(),
@@ -1801,12 +2082,12 @@ class Runtime:
                  "time": time.time()})
             if rec.is_actor_creation:
                 actor = self.actors[rec.actor_id]
+                worker.inflight.pop(task_id_bin, None)
                 if ok:
                     actor.status = ALIVE
                     actor.worker = worker
                     actor.node = rec.node
                     worker.actor_id = rec.actor_id
-                    worker.current = None
                     if not actor.created_future.done():
                         actor.created_future.set_result(True)
                     self._pump_actor_locked(actor)
@@ -1819,50 +2100,21 @@ class Runtime:
                     if not actor.created_future.done():
                         actor.created_future.set_exception(err)
                     self._fail_actor_queue_locked(actor, err)
-                    self._release_worker_locked(worker, rec, reap=True)
+                    self._end_lease_locked(worker, reap=True)
                 return
             if worker.actor_id is not None:
                 actor = self.actors.get(worker.actor_id)
                 if actor is not None:
                     actor.inflight.pop(task_id_bin, None)
                     self._pump_actor_locked(actor)
-                worker.current = None
                 return
-            self._release_worker_locked(worker, rec)
+            worker.inflight.pop(task_id_bin, None)
+            # Top up this worker's pipeline (and everyone else's) before
+            # deciding the lease is over.
             self._dispatch_locked()
-
-    def _release_task_resources_locked(self, worker: WorkerHandle,
-                                       rec: TaskRecord):
-        node = rec.node
-        if node is None:
-            return
-        if not worker.released:
-            if rec.pg_id is not None:
-                pg = self.placement_groups.get(rec.pg_id)
-                if pg is not None and not pg.removed:
-                    self._pg_release_locked(pg, rec.bundle_index or 0,
-                                            rec.requirements)
-            else:
-                node.release(rec.requirements)
-        worker.released = False
-        if worker.tpu_chips:
-            node.tpu_free.extend(worker.tpu_chips)
-            worker.tpu_chips = []
-
-    def _release_worker_locked(self, worker: WorkerHandle, rec: TaskRecord,
-                               reap=False):
-        had_tpu = bool(worker.tpu_chips)
-        self._release_task_resources_locked(worker, rec)
-        worker.current = None
-        worker.idle_since = time.monotonic()
-        if reap or had_tpu:
-            # TPU workers are dedicated: the chip set is baked into the
-            # process env at spawn, so return the chips and retire the
-            # worker rather than cache it.
-            self._kill_worker_locked(worker)
-        else:
-            worker.node.idle_workers.setdefault(worker.env_key, []).append(
-                worker)
+            if not worker.inflight and not worker.dead \
+                    and worker.lease_req is not None:
+                self._end_lease_locked(worker)
 
     def _kill_worker_locked(self, worker: WorkerHandle):
         worker.dead = True
@@ -1889,12 +2141,16 @@ class Runtime:
             for key, lst in worker.node.idle_workers.items():
                 if worker in lst:
                     lst.remove(worker)
-            rec = worker.current
             if worker.actor_id is not None:
                 self._on_actor_worker_death(worker)
                 return
-            if rec is not None:
-                self._release_task_resources_locked(worker, rec)
+            inflight = list(worker.inflight.values())
+            worker.inflight.clear()
+            self._end_lease_locked(worker)
+            for rec in inflight:
+                # Every task pipelined onto the dead worker retries
+                # elsewhere (reference: task retries by the owner,
+                # task_manager.h:174).
                 if rec.retries_left > 0 and not rec.cancelled:
                     rec.retries_left -= 1
                     rec.dispatched = False
@@ -1903,9 +2159,11 @@ class Runtime:
                     self._enqueue_pending_locked(rec)
                 else:
                     self.tasks.pop(rec.spec["task_id"], None)
-                    err = exc.WorkerCrashedError(
-                        f"Worker died executing "
-                        f"{rec.spec.get('name', 'task')}")
+                    err = (exc.TaskCancelledError(
+                               rec.spec.get("name", "task"))
+                           if rec.cancelled else exc.WorkerCrashedError(
+                               f"Worker died executing "
+                               f"{rec.spec.get('name', 'task')}"))
                     self._fail_task_locked(rec, err)
             self._dispatch_locked()
 
@@ -1913,25 +2171,10 @@ class Runtime:
         actor = self.actors.get(worker.actor_id)
         if actor is None:
             return
-        node = actor.node or worker.node
-        # Release the actor's held resources.
-        creation = None
-        for t in self.tasks.values():
-            if t.is_actor_creation and t.actor_id == worker.actor_id:
-                creation = t
+        # The actor held its creation lease for life; return it (resources,
+        # PG bundle share, TPU chips).
+        self._end_lease_locked(worker)
         req = actor.options.get("resources") or {"CPU": 1.0}
-        strategy = actor.options.get("scheduling_strategy")
-        in_pg = strategy is not None and strategy[0] == "placement_group"
-        if node is not None and not worker.released:
-            if in_pg:
-                pg = self.placement_groups.get(strategy[1])
-                if pg is not None and not pg.removed:
-                    self._pg_release_locked(pg, strategy[2] or 0, req)
-            else:
-                node.release(req)
-        if node is not None and worker.tpu_chips:
-            node.tpu_free.extend(worker.tpu_chips)
-            worker.tpu_chips = []
         err = exc.ActorDiedError(
             f"Actor {worker.actor_id.hex()} died (worker exit)")
         for tid_bin, rec in list(actor.inflight.items()):
@@ -2068,11 +2311,52 @@ class Runtime:
                 self._fail_task_locked(rec, exc.TaskCancelledError(
                     rec.spec.get("name", "task")))
             elif force and rec.worker is not None:
+                rec.retries_left = 0
+                w = rec.worker
+                if w.actor_id is not None or not w.inflight:
+                    # Actor worker (no pipelined plain tasks) or nothing to
+                    # rescue: kill immediately.
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
+                else:
+                    # Steal back every unstarted pipelined task first; the
+                    # "stolen" handler terminates the process only if the
+                    # victim had actually started (bystanders would
+                    # otherwise burn retries or die as WorkerCrashedError).
+                    w.pending_force_kill = rec.spec["task_id"]
+                    try:
+                        w.send(("steal", 0, list(w.inflight.keys())))
+                    except Exception:
+                        try:
+                            w.proc.terminate()
+                        except Exception:
+                            pass
+                    # A wedged worker (GIL held in C code) never answers
+                    # the steal — the whole point of force-kill.  Fall back
+                    # to terminate if no "stolen" reply resolves it in time.
+                    def _force_kill_fallback(w=w):
+                        with self.lock:
+                            if w.pending_force_kill is None or w.dead:
+                                return
+                            w.pending_force_kill = None
+                        try:
+                            w.proc.terminate()
+                        except Exception:
+                            pass
+                    t = threading.Timer(2.0, _force_kill_fallback)
+                    t.daemon = True
+                    t.start()
+            elif rec.worker is not None:
+                # Pipelined onto a worker but possibly not started: try to
+                # steal it back; the "stolen" handler sees cancelled=True
+                # and fails it.  Already-started tasks are uncancellable
+                # without force (reference semantics).
                 try:
-                    rec.worker.proc.terminate()
+                    rec.worker.send(("steal", 0, [rec.spec["task_id"]]))
                 except Exception:
                     pass
-                rec.retries_left = 0
 
     # ---------------------------------------------------------- shutdown --
     def shutdown(self):
@@ -2114,10 +2398,17 @@ class Runtime:
             except Exception:
                 pass
         self.shm.cleanup()
-        try:
-            self._io_wakeup_w.send_bytes(b"x")
-        except Exception:
-            pass
+        # Worker-created segments (task results still referenced at exit)
+        # are in this session's namespace but not in the driver store's
+        # created-set; sweep them by prefix.
+        import glob as _glob
+
+        for path in _glob.glob(os.path.join(
+                self.shm._dir, f"rtpu-{self.session_id}-*")):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         try:
             import shutil
 

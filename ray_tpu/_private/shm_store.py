@@ -171,6 +171,7 @@ class ShmStore:
         (within 2x waste), else a fresh shm file.  Fresh allocations evict
         pooled (free) segments first when that makes room under capacity."""
         evict = []
+        new_name = self.segment_name(object_id)
         with self._lock:
             for i, (size, name, mm) in enumerate(self._pool):
                 if size >= total:
@@ -178,7 +179,13 @@ class ShmStore:
                         self._pool.pop(i)
                         self._pool_bytes -= size
                         self._used -= size  # re-added by create_from_parts
-                        return name, mm, size
+                        # Rename to the new object's canonical name: the
+                        # mmap stays valid (it binds the inode, not the
+                        # path) and the segment-name -> ObjectID invariant
+                        # that lineage recovery parses stays true.
+                        os.rename(_segment_path(self._dir, name),
+                                  _segment_path(self._dir, new_name))
+                        return new_name, mm, size
                     break  # sorted: everything later is even more wasteful
             if self._capacity:
                 # Pooled bytes are free memory: give them back before

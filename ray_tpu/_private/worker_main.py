@@ -55,6 +55,14 @@ class _WorkerRuntime:
         self.req_counter = itertools.count(1)
         self.pending: Dict[int, "queue.SimpleQueue"] = {}
         self.pending_lock = threading.Lock()
+        # Dropped refs accumulate here and ride out as one ("decref_batch")
+        # before the next outgoing message (or via the periodic flusher).
+        # Append-only from ObjectRef.__del__: __del__ can fire from GC *during*
+        # protocol.send's pickling, so it must never take send_lock itself.
+        # RLock, not Lock: a GC pass triggered by an allocation made while
+        # holding this lock can re-enter __del__ on the same thread.
+        self._decref_buf: list = []
+        self._decref_lock = threading.RLock()
         # Per-thread task context: concurrent actor threads must not
         # cross-contaminate (reference: per-thread context in worker.py).
         self._tls = threading.local()
@@ -96,8 +104,20 @@ class _WorkerRuntime:
 
     # -- plumbing ----------------------------------------------------------
     def _send(self, msg):
+        with self._decref_lock:
+            buf, self._decref_buf = self._decref_buf, []
         with self.send_lock:
+            if buf:
+                protocol.send(self.conn, ("decref_batch", buf))
             protocol.send(self.conn, msg)
+
+    def flush_decrefs(self):
+        with self._decref_lock:
+            if not self._decref_buf:
+                return
+            buf, self._decref_buf = self._decref_buf, []
+        with self.send_lock:
+            protocol.send(self.conn, ("decref_batch", buf))
 
     def _request(self, msg_builder):
         req_id = next(self.req_counter)
@@ -154,8 +174,13 @@ class _WorkerRuntime:
         self._send(("addref", object_id.binary()))
 
     def remove_local_reference(self, object_id: ObjectID):
+        # Buffered, not sent: this runs from ObjectRef.__del__, which the GC
+        # may invoke mid-pickle inside _send — taking send_lock here would
+        # self-deadlock.  The batch is flushed before the next outgoing
+        # message and by the periodic flusher thread.
         try:
-            self._send(("decref", object_id.binary()))
+            with self._decref_lock:
+                self._decref_buf.append(object_id.binary())
         except Exception:
             pass  # shutting down
 
@@ -177,24 +202,31 @@ class _WorkerRuntime:
         return out
 
     def get_objects(self, refs, timeout=None):
-        values = []
-        for ref in refs:
+        """Batched get: ONE round trip for all non-cached refs (reference:
+        CoreWorker::Get takes the whole id list, core_worker.cc:1250 — the
+        per-ref chatter of v1 was the multi-client bottleneck)."""
+        values = [None] * len(refs)
+        missing = []
+        for i, ref in enumerate(refs):
             oid = ref.id()
             if oid in self._local_cache:
-                values.append(self._local_cache[oid])
-                continue
-            tid = self.current_task_id
-            self._send(("blocked", tid.binary() if tid else b""))
-            try:
-                reply = self._request(
-                    lambda rid: ("get", rid, oid.binary(), timeout)
-                )
-            finally:
-                self._send(("unblocked", tid.binary() if tid else b""))
-            ok, descr = reply
+                values[i] = self._local_cache[oid]
+            else:
+                missing.append((i, oid))
+        if not missing:
+            return values
+        tid = self.current_task_id
+        self._send(("blocked", tid.binary() if tid else b""))
+        try:
+            reply = self._request(
+                lambda rid: ("mget", rid,
+                             [oid.binary() for _, oid in missing], timeout))
+        finally:
+            self._send(("unblocked", tid.binary() if tid else b""))
+        for (i, _oid), (ok, descr) in zip(missing, reply):
             if not ok:
                 raise self.materialize_error(descr)
-            values.append(self.materialize(descr))
+            values[i] = self.materialize(descr)
         return values
 
     def materialize_error(self, descr):
@@ -219,13 +251,15 @@ class _WorkerRuntime:
         return ObjectRef(oid)
 
     def submit_task(self, spec: dict) -> list:
-        """Nested task submission from inside a worker (reference: tasks may
-        spawn tasks; ownership stays with the driver in v1)."""
-        reply = self._request(lambda rid: ("submit", rid, spec))
-        assert reply == "ok", reply
+        """Nested task submission from inside a worker — fire-and-forget
+        (reference: PushNormalTask pipelines submissions without blocking,
+        direct_task_transport.cc:568).  Safe without an ack because messages
+        on this connection are FIFO: any later get/decref/nested-use of the
+        returned refs is processed by the driver after the submit itself."""
+        self._send(("submit", 0, spec))
         tid = TaskID(spec["task_id"])
-        # _register=False: the driver counted this worker's reference at
-        # submission (see Runtime.submit_task_from_worker).
+        # _register=False: the driver counts this worker's reference when it
+        # receives the spec (see Runtime.submit_task_from_worker).
         return [ObjectRef(tid.object_id(i), _register=False)
                 for i in range(spec["num_returns"])]
 
@@ -407,7 +441,11 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
     os.environ.update(env)
     global _runtime
     send_lock = threading.Lock()
-    shm = ShmStore(shm_dir=shm_dir, session_id=session)
+    # Workers pool freed segments too (the driver routes "free_segment" back
+    # to the creating worker) — without this, every worker-side put writes
+    # fresh tmpfs pages at fault+zero speed instead of memcpy speed.
+    shm = ShmStore(shm_dir=shm_dir, session_id=session,
+                   pool_bytes=int(os.environ.get("RAY_TPU_POOL_BYTES", "0")))
     rt = _WorkerRuntime(conn, send_lock, shm, max_inline)
     rt.worker_id_hex = worker_id_hex
     rt.node_id_hex = node_id_hex
@@ -420,35 +458,83 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
 
     fns = _FunctionCache()
     actors: Dict[bytes, Any] = {}
-    task_queue: "queue.SimpleQueue" = queue.SimpleQueue()
+    # Deque + condition (not SimpleQueue) so the driver can steal back
+    # queued-but-unstarted tasks when this worker blocks in ray.get
+    # (reference: work stealing in direct_task_transport's pipelining).
+    import collections
+
+    tasks = collections.deque()
+    tq_cv = threading.Condition()
     pool: Optional[ThreadPoolExecutor] = None
     max_concurrency = 1
+
+    def steal(steal_id, wanted: set):
+        stolen = []
+        with tq_cv:
+            kept = collections.deque()
+            while tasks:
+                m = tasks.popleft()
+                if m[0] == "exec" and "actor_id" not in m[1] \
+                        and m[1]["task_id"] in wanted:
+                    stolen.append(m[1]["task_id"])
+                else:
+                    kept.append(m)
+            tasks.extend(kept)
+        rt._send(("stolen", steal_id, stolen))
 
     def reader():
         while True:
             try:
                 msg = protocol.recv(conn)
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
                 os._exit(0)
             tag = msg[0]
             if tag in ("exec", "create_actor", "kill"):
-                task_queue.put(msg)
+                with tq_cv:
+                    tasks.append(msg)
+                    tq_cv.notify()
+            elif tag == "steal":
+                steal(msg[1], set(msg[2]))
             elif tag == "func":
                 fns.put(msg[1], msg[2])
             elif tag == "obj":
                 rt.deliver_reply(msg[1], (msg[2], msg[3]))
-            elif tag == "submitted":
-                rt.deliver_reply(msg[1], "ok")
+            elif tag == "mgot":
+                rt.deliver_reply(msg[1], msg[2])
             elif tag == "waited":
                 rt.deliver_reply(msg[1], msg[2])
             elif tag == "reply":
                 rt.deliver_reply(msg[1], msg[2])
+            elif tag == "free_segment":
+                # The owner freed an object whose segment this worker
+                # created; pool the pages for in-place reuse when no other
+                # process ever mapped them (reference: plasma arena reuse).
+                try:
+                    rt.shm.unlink(msg[1], msg[2], reusable=msg[3])
+                except Exception:
+                    pass
 
     threading.Thread(target=reader, daemon=True, name="ray_tpu-reader").start()
+
+    def decref_flusher():
+        import time as _time
+
+        while True:
+            _time.sleep(0.25)
+            try:
+                rt.flush_decrefs()
+            except Exception:
+                return  # conn gone; reader exits the process
+
+    threading.Thread(target=decref_flusher, daemon=True,
+                     name="ray_tpu-decref").start()
     protocol.send(conn, ("ready", worker_id_hex, os.getpid()))
 
     while True:
-        msg = task_queue.get()
+        with tq_cv:
+            while not tasks:
+                tq_cv.wait()
+            msg = tasks.popleft()
         tag = msg[0]
         if tag == "kill":
             os._exit(0)

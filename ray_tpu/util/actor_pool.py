@@ -36,6 +36,11 @@ class ActorPool:
                 or bool(self._ready))
 
     def _complete_one(self, timeout=None):
+        """-> (idx, ok, result-or-exception).  Task errors are captured, not
+        raised: raising after the future is popped but before its index is
+        buffered would wedge ordered get_next forever (the index could never
+        appear in _ready).  Reference: _next_return_index semantics in
+        python/ray/util/actor_pool.py."""
         done, _ = ray.wait(list(self._future_to_meta), num_returns=1,
                            timeout=timeout)
         if not done:
@@ -47,7 +52,10 @@ class ActorPool:
             self._future_to_meta[fn(actor, value)] = (actor, pidx)
         else:
             self._idle.append(actor)
-        return idx, ray.get(fut)
+        try:
+            return idx, True, ray.get(fut)
+        except Exception as e:  # noqa: BLE001 — surfaced at yield time
+            return idx, False, e
 
     def get_next(self, timeout=None) -> Any:
         """Next result in SUBMISSION order (reference semantics:
@@ -65,10 +73,13 @@ class ActorPool:
         while want not in self._ready:
             remaining = (None if deadline is None
                          else max(0.0, deadline - _time.monotonic()))
-            idx, result = self._complete_one(remaining)
-            self._ready[idx] = result
+            idx, ok, result = self._complete_one(remaining)
+            self._ready[idx] = (ok, result)
         self._next_return += 1
-        return self._ready.pop(want)
+        ok, result = self._ready.pop(want)
+        if not ok:
+            raise result
+        return result
 
     def get_next_unordered(self, timeout=None) -> Any:
         """Next result in COMPLETION order."""
@@ -78,9 +89,12 @@ class ActorPool:
             # Results already fetched while waiting in-order: drain first.
             idx = next(iter(self._ready))
             self._consumed.add(idx)
-            return self._ready.pop(idx)
-        idx, result = self._complete_one(timeout)
-        self._consumed.add(idx)
+            ok, result = self._ready.pop(idx)
+        else:
+            idx, ok, result = self._complete_one(timeout)
+            self._consumed.add(idx)
+        if not ok:
+            raise result
         return result
 
     def map(self, fn: Callable[[Any, Any], Any],
@@ -97,8 +111,10 @@ class ActorPool:
                 want += 1
             if not self.has_next():
                 break
-            idx, result = self._complete_one()
+            idx, ok, result = self._complete_one()
             self._consumed.add(idx)
+            if not ok:
+                raise result
             buffered[idx] = result
         while want in buffered:
             yield buffered.pop(want)

@@ -255,7 +255,11 @@ def recv(src_rank: int, group_name: str = "default") -> np.ndarray:
 
 
 def destroy_collective_group(group_name: str = "default"):
-    g = _groups().pop(group_name, None)
+    # Mutate _GROUPS itself under its lock — _groups() hands out a copy, so
+    # popping from that copy would leak the entry and make any later
+    # destroy-then-reinit of the same name fail the duplicate check.
+    with _groups_lock:
+        g = _GROUPS.pop(group_name, None)
     if g is not None and g.rank == 0:
         try:
             ray.kill(g.coordinator)
