@@ -14,7 +14,7 @@ driver → worker
   ("exec",   task: dict)            run a task / actor method
   ("create_actor", spec: dict)      instantiate actor class on this worker
   ("func",   func_id, payload)      function/class definition (cloudpickle)
-  ("obj",    req_id, ok, descr)     reply to a worker "get"/"getparts"
+  ("obj",    req_id, ok, descr)     reply to a worker "getparts"
   ("mgot",   req_id, [(ok, descr)]) reply to a batched "mget"
   ("free_segment", name, size, reusable)  owner freed a segment this worker
                                     created; pool pages iff reusable
@@ -22,7 +22,6 @@ driver → worker
 worker → driver
   ("ready",  worker_id_hex, pid)
   ("result", task_id_bytes, ok, returns: list[Descr], meta: dict)
-  ("get",    req_id, object_id_bytes, timeout)
   ("mget",   req_id, [object_id_bytes], timeout)   batched get
   ("submit", 0, spec: dict)         nested task submission (fire-and-forget;
                                     per-conn FIFO makes later uses safe)

@@ -368,6 +368,8 @@ class Runtime:
         # so consumers know whether a segment is locally attachable or must
         # be shipped (reference: owner-based object directory).
         self.store_id = os.urandom(8).hex()
+        self.spill_dir = (config.spill_dir
+                          or f"/tmp/ray_tpu_spill_{self.session_id}")
         self._stopped = False
         self._extra_workers = 0
 
@@ -528,6 +530,11 @@ class Runtime:
     def _maybe_free_locked(self, oid: ObjectID, st: ObjectState):
         if st.refcount() <= 0 and not st.futures and not st.waiters:
             self.objects.pop(oid, None)
+            if st.descr is not None and st.descr[0] == protocol.SPILLED:
+                try:
+                    os.unlink(st.descr[1])
+                except OSError:
+                    pass
             if st.descr is not None and st.descr[0] == protocol.SHM:
                 home = st.descr[3] if len(st.descr) > 3 else self.store_id
                 cw = st.creator
@@ -566,8 +573,79 @@ class Runtime:
             value, self.config.max_inline_object_size)
         if res[0] == "inline":
             return (protocol.INLINE, res[1])
-        name, size = self.shm.create_from_parts(object_id, res[1], res[2])
+        try:
+            name, size = self.shm.create_from_parts(object_id, res[1],
+                                                    res[2])
+        except MemoryError:
+            # Store full: spill LRU unpinned residents to disk, then retry;
+            # if still no room, write the new object straight to disk
+            # (reference: LocalObjectManager spilling + the plasma
+            # CreateRequestQueue fallback, local_object_manager.h:41).
+            need = (sum(len(b) for b in res[2]) + len(res[1]) + 65536)
+            self._spill_objects(need)
+            try:
+                name, size = self.shm.create_from_parts(object_id, res[1],
+                                                        res[2])
+            except MemoryError:
+                path, size = self.shm.create_spilled(
+                    object_id, res[1], res[2], self.spill_dir)
+                return (protocol.SPILLED, path, size, self.store_id)
         return (protocol.SHM, name, size, self.store_id)
+
+    def _spill_objects(self, need_bytes: int) -> int:
+        """Move LRU-ish unpinned READY resident objects to spill_dir until
+        ``need_bytes`` of shm is freed (or no victims remain).  Insertion
+        order of the object table approximates LRU (plasma's eviction
+        policy is LRU too, eviction_policy.h)."""
+        freed = 0
+        with self.lock:
+            victims = []
+            total = 0
+            for oid, st in self.objects.items():
+                if (st.status == READY and st.pins == 0
+                        and st.descr is not None
+                        and st.descr[0] == protocol.SHM
+                        and not st.shipped
+                        and (len(st.descr) < 4
+                             or st.descr[3] == self.store_id)
+                        and st.segment is None):
+                    victims.append((oid, st))
+                    total += st.descr[2]
+                    if total >= need_bytes:
+                        break
+            # Pin the victims: a concurrent free or a second spill pass
+            # must not touch them while the copies run WITHOUT the lock
+            # (multi-GB disk copies must not stall the whole driver).
+            for _oid, st in victims:
+                st.pins += 1
+        done = []
+        for oid, st in victims:
+            name, size = st.descr[1], st.descr[2]
+            try:
+                path = self.shm.spill(name, size, self.spill_dir)
+            except OSError:
+                path = None
+            done.append((oid, st, name, size, path))
+            if path is not None:
+                freed += size
+        with self.lock:
+            for oid, st, name, size, path in done:
+                st.pins -= 1
+                if path is not None:
+                    creator = st.creator
+                    st.descr = (protocol.SPILLED, path, size,
+                                self.store_id)
+                    st.creator = None
+                    if creator is not None and not creator.dead:
+                        # The creating worker may still hold the (now
+                        # deleted) file's pages mapped in its pool: let go.
+                        try:
+                            creator.send(("free_segment", name, size,
+                                          False))
+                        except Exception:
+                            pass
+                self._maybe_free_locked(oid, st)
+        return freed
 
     def put_object(self, value):
         from ray_tpu._private.object_ref import ObjectRef
@@ -673,11 +751,28 @@ class Runtime:
             try:
                 seg = self.shm.attach(descr[1])
             except FileNotFoundError:
+                with self.lock:
+                    st3 = self.objects.get(oid)
+                    respilled = (st3 is not None and st3.descr is not None
+                                 and st3.descr[0] == protocol.SPILLED)
+                if respilled:
+                    # Raced with the spiller: the object moved to disk
+                    # between descriptor read and attach.
+                    return self._materialize(oid, _recovering=_recovering)
                 if _recovering or not self._recover_and_wait(oid):
                     raise exc.ObjectLostError(
                         f"Object {oid.hex()}: segment {descr[1]} missing "
                         f"and not recoverable")
                 return self._materialize(oid, _recovering=True)
+            value = seg.deserialize()
+            with self.lock:
+                st2 = self.objects.get(oid)
+                if st2 is not None:
+                    st2.segment = seg
+        elif kind == protocol.SPILLED:
+            # Restore from external storage (reference:
+            # local_object_manager.h restore path).
+            seg = self.shm.attach_path(descr[1])
             value = seg.deserialize()
             with self.lock:
                 st2 = self.objects.get(oid)
@@ -808,7 +903,10 @@ class Runtime:
         without the runtime lock held."""
         home = descr[3] if len(descr) > 3 else self.store_id
         if home == self.store_id:
-            seg = self.shm.attach(descr[1])
+            if descr[0] == protocol.SPILLED:
+                seg = self.shm.attach_path(descr[1])
+            else:
+                seg = self.shm.attach(descr[1])
             try:
                 meta, bufs = seg.raw_parts()
                 return bytes(meta), [bytes(b) for b in bufs]
@@ -1021,8 +1119,15 @@ class Runtime:
         # Actor creations get singleton classes: their worker becomes the
         # actor, so plain tasks must never pipeline onto its lease.
         marker = rec.actor_id if rec.is_actor_creation else None
+        # runtime_env is part of the class: env_vars are baked into the
+        # worker process at spawn, so tasks with different envs must never
+        # share a lease (reference: SchedulingKey includes runtime_env
+        # hash).
+        env = rec.spec.get("runtime_env") or {}
+        ekey = repr(sorted(env.get("env_vars", {}).items())) \
+            if env.get("env_vars") else None
         return (tuple(sorted(rec.requirements.items())),
-                rec.pg_id, rec.bundle_index, skey, marker)
+                rec.pg_id, rec.bundle_index, skey, marker, ekey)
 
     def _enqueue_pending_locked(self, rec: "TaskRecord"):
         self.pending_tasks.setdefault(
@@ -1432,6 +1537,13 @@ class Runtime:
         # by the submitter's store (driver or worker), freed there.
         creator = spec.get("_creator_worker")
         for name, size in spec.get("tmp_segments", []):
+            if os.path.isabs(name):
+                # A spill-file path (store was full at submission time).
+                try:
+                    os.unlink(name)
+                except OSError:
+                    pass
+                continue
             if creator is not None and not creator.dead:
                 try:
                     creator.send(("free_segment", name, size, False))
@@ -1727,8 +1839,6 @@ class Runtime:
                     msg[2])
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
-        elif tag == "get":
-            self._on_worker_get(worker, msg[1], msg[2], msg[3])
         elif tag == "getparts":
             # Worker holds a descriptor for a segment in another node's
             # store: ship the serialized parts.  Fetch may block on a
@@ -1737,6 +1847,17 @@ class Runtime:
 
             def fetch_and_reply(worker=worker, rid=rid, descr=descr):
                 try:
+                    # The worker's descriptor may be stale (object spilled
+                    # or restored since): the owner's table has the current
+                    # location.
+                    cur_oid = self._oid_from_segment_name(descr[1])
+                    if cur_oid is not None:
+                        with self.lock:
+                            st0 = self.objects.get(cur_oid)
+                            if st0 is not None and st0.descr is not None \
+                                    and st0.descr[0] in (protocol.SHM,
+                                                         protocol.SPILLED):
+                                descr = st0.descr
                     try:
                         meta, bufs = self._fetch_parts(descr)
                     except exc.ObjectLostError:
@@ -1789,6 +1910,17 @@ class Runtime:
                 if count["ready"] >= num_returns or not pend:
                     count["sent"] = True
                 else:
+                    # The wait really blocks this worker: steal back its
+                    # pipelined-but-unstarted tasks — one of them may be
+                    # what the wait awaits (same head-of-line hazard as
+                    # the mget path).
+                    stealable = [tid for tid, r in worker.inflight.items()
+                                 if not r.is_actor_creation]
+                    if stealable:
+                        try:
+                            worker.send(("steal", 0, stealable))
+                        except Exception:
+                            pass
                     def cb(_oid):
                         count["ready"] += 1
                         if count["ready"] >= num_returns and not count["sent"]:
@@ -1878,15 +2010,6 @@ class Runtime:
                         and worker.lease_pg is None):
                     worker.node.release(worker.lease_req)
                     worker.released = True
-                # Steal back pipelined-but-unstarted tasks: one of them may
-                # be exactly what this worker's ray.get is waiting for
-                # (head-of-line deadlock; reference: work stealing in
-                # direct_task_transport).  The worker replies "stolen" with
-                # the ids it had not started; those re-dispatch elsewhere.
-                stealable = [tid for tid, r in worker.inflight.items()
-                             if not r.is_actor_creation]
-                if stealable:
-                    worker.send(("steal", 0, stealable))
                 self._dispatch_locked()
         elif tag == "unblocked":
             with self.lock:
@@ -1963,48 +2086,6 @@ class Runtime:
                 self._enqueue_pending_locked(rec)
                 self._dispatch_locked()
 
-    def _on_worker_get(self, worker: WorkerHandle, rid, oid_bin, timeout):
-        oid = ObjectID(oid_bin)
-        sent = {"done": False}
-
-        def reply():
-            with self.lock:
-                if sent["done"]:
-                    return
-                sent["done"] = True
-                st = self.objects.get(oid)
-                if st is None:
-                    err = serialization.dumps_inline(
-                        exc.ObjectLostError(f"Object {oid.hex()} lost"))
-                    worker.send(("obj", rid, False, (protocol.ERROR, err)))
-                    return
-                ok = st.status == READY
-                descr = st.descr
-                st.shipped = True
-            worker.send(("obj", rid, ok, descr))
-
-        def timed_out():
-            with self.lock:
-                if sent["done"]:
-                    return
-                sent["done"] = True
-            err = serialization.dumps_inline(exc.GetTimeoutError(
-                f"Timed out getting {oid.hex()} after {timeout}s"))
-            worker.send(("obj", rid, False, (protocol.ERROR, err)))
-
-        with self.lock:
-            st = self.objects.get(oid)
-            if st is None or st.status != PENDING:
-                pass  # reply immediately below
-            else:
-                st.waiters.append(lambda _oid: reply())
-                if timeout is not None:
-                    t = threading.Timer(timeout, timed_out)
-                    t.daemon = True
-                    t.start()
-                return
-        reply()
-
     def _on_worker_mget(self, worker: WorkerHandle, rid, id_bins, timeout):
         """Batched worker get: ONE reply listing (ok, descr) per id, sent
         when all are complete (or the timeout fires).  Reference:
@@ -2044,8 +2125,23 @@ class Runtime:
                     if (st := self.objects.get(ObjectID(b))) is not None
                     and st.status == PENDING]
             if not pend:
+                # Everything ready: answer immediately, no steal — the
+                # worker unblocks right away, so stripping its pipeline
+                # would be pure churn.
                 finish_locked()
                 return
+            # The get really waits.  Steal back the worker's pipelined-but-
+            # unstarted tasks: one of them may be (or produce a dependency
+            # of) exactly what this get awaits — the head-of-line deadlock
+            # (reference: work stealing in direct_task_transport).  The
+            # worker replies "stolen" with the ids it had not started.
+            stealable = [tid for tid, r in worker.inflight.items()
+                         if not r.is_actor_creation]
+            if stealable:
+                try:
+                    worker.send(("steal", 0, stealable))
+                except Exception:
+                    pass
             state["left"] = len(pend)
 
             def cb(_oid):  # runs under self.lock (RLock) in _complete
@@ -2409,6 +2505,12 @@ class Runtime:
                 os.unlink(path)
             except OSError:
                 pass
+        try:
+            import shutil as _shutil
+
+            _shutil.rmtree(self.spill_dir, ignore_errors=True)
+        except Exception:
+            pass
         try:
             import shutil
 

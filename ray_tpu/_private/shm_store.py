@@ -129,26 +129,7 @@ class ShmStore:
         the plasma create→write-in-place→seal path (``plasma/client.cc``):
         the caller serializes once and each buffer is memcpy'd exactly once,
         directly into shared memory."""
-        sizes = [len(b) for b in buffers]
-        # Reserve space for the header + buffer table pickle.  The table is
-        # pickled together with the payload meta so readers need one load.
-        payload = (None, None, meta)  # placeholder to measure table size
-        # Two-pass: compute offsets assuming a table pickle of the final
-        # length.  Table size varies with offsets' magnitude only slightly;
-        # pad generously instead of iterating.
-        probe = serialization.dumps_inline(([0] * len(sizes), sizes, meta))
-        table_room = len(probe) + 256
-        base = _HEADER.size + table_room
-        offsets, total = serialization.aligned_offsets(sizes, base)
-        table = serialization.dumps_inline((offsets, sizes, meta))
-        if len(table) > table_room:
-            # Offsets grew the pickle beyond the pad (pathological); redo
-            # with exact room.
-            table_room = len(table) + 256
-            base = _HEADER.size + table_room
-            offsets, total = serialization.aligned_offsets(sizes, base)
-            table = serialization.dumps_inline((offsets, sizes, meta))
-
+        table, offsets, total = self._layout(meta, buffers)
         name, mm, alloc = self._acquire_segment(object_id, total)
         _HEADER.pack_into(mm, 0, _MAGIC, len(table))
         mm[_HEADER.size : _HEADER.size + len(table)] = table
@@ -165,6 +146,28 @@ class ShmStore:
             self._used += alloc
             self._created.add(name)
         return name, alloc
+
+    def _layout(self, meta: bytes, buffers: List[memoryview]):
+        """(table_pickle, buffer_offsets, total_size) for the segment
+        layout: [header][table][aligned buffers...].  The table is pickled
+        together with the payload meta so readers need one load.  Two-pass:
+        compute offsets assuming a table pickle of the final length; table
+        size varies with offsets' magnitude only slightly, so pad
+        generously instead of iterating."""
+        sizes = [len(b) for b in buffers]
+        probe = serialization.dumps_inline(([0] * len(sizes), sizes, meta))
+        table_room = len(probe) + 256
+        base = _HEADER.size + table_room
+        offsets, total = serialization.aligned_offsets(sizes, base)
+        table = serialization.dumps_inline((offsets, sizes, meta))
+        if len(table) > table_room:
+            # Offsets grew the pickle beyond the pad (pathological); redo
+            # with exact room.
+            table_room = len(table) + 256
+            base = _HEADER.size + table_room
+            offsets, total = serialization.aligned_offsets(sizes, base)
+            table = serialization.dumps_inline((offsets, sizes, meta))
+        return table, offsets, total
 
     def _acquire_segment(self, object_id: ObjectID, total: int):
         """A writable mapping of >= ``total`` bytes: pooled if one fits
@@ -219,14 +222,56 @@ class ShmStore:
         return name, mm, total
 
     def attach(self, name: str) -> Segment:
-        path = _segment_path(self._dir, name)
+        return self.attach_path(_segment_path(self._dir, name))
+
+    def attach_path(self, path: str) -> Segment:
+        """Map a segment by absolute path — shm or a spill file (restore
+        path; reference: local_object_manager.h:41 restore-from-external).
+        The on-disk layout is identical, so readers cannot tell spilled
+        objects from resident ones."""
         fd = os.open(path, os.O_RDONLY)
         try:
             size = os.fstat(fd).st_size
             mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
         finally:
             os.close(fd)
-        return Segment(name, path, size, mm)
+        return Segment(os.path.basename(path), path, size, mm)
+
+    def spill(self, name: str, size: int, spill_dir: str) -> str:
+        """Copy a resident segment to ``spill_dir`` and free its shm pages
+        (reference: LocalObjectManager::SpillObjects,
+        local_object_manager.h:41).  Copy (not rename): /dev/shm -> disk is
+        cross-device, and the point is releasing tmpfs RAM."""
+        import shutil
+
+        os.makedirs(spill_dir, exist_ok=True)
+        src = _segment_path(self._dir, name)
+        dst = os.path.join(spill_dir, name)
+        with open(src, "rb") as f, open(dst, "wb") as g:
+            shutil.copyfileobj(f, g, 1 << 20)
+        self.unlink(name, size)
+        return dst
+
+    def create_spilled(self, object_id: ObjectID, meta: bytes,
+                       buffers: List[memoryview],
+                       spill_dir: str) -> Tuple[str, int]:
+        """Serialize directly to a spill file, bypassing shm entirely — the
+        over-capacity path when nothing (enough) can be evicted."""
+        os.makedirs(spill_dir, exist_ok=True)
+        table, offsets, total = self._layout(meta, buffers)
+        path = os.path.join(spill_dir, self.segment_name(object_id))
+        with open(path, "wb") as f:
+            mm_bytes = bytearray(_HEADER.size)
+            _HEADER.pack_into(mm_bytes, 0, _MAGIC, len(table))
+            f.write(mm_bytes)
+            f.write(table)
+            pos = _HEADER.size + len(table)
+            for off, buf in zip(offsets, buffers):
+                if off > pos:
+                    f.write(b"\x00" * (off - pos))
+                f.write(buf)
+                pos = off + len(buf)
+        return path, total
 
     def unlink(self, name: str, size: int = 0, reusable: bool = False):
         """Free a segment.  ``reusable=True`` (caller guarantees no other

@@ -143,7 +143,7 @@ class _WorkerRuntime:
             return serialization.loads_inline(descr[1])
         if kind == protocol.PARTS:
             return serialization.loads(descr[1], descr[2])
-        if kind == protocol.SHM:
+        if kind in (protocol.SHM, protocol.SPILLED):
             if len(descr) > 3 and descr[3] != self.store_id:
                 # Segment homed in another node's store: ask the driver to
                 # ship its serialized parts (reference: ObjectManager pull
@@ -153,7 +153,20 @@ class _WorkerRuntime:
                 if not ok:
                     raise self.materialize_error(reply)
                 return self.materialize(reply)
-            seg = self.shm.attach(descr[1])
+            try:
+                if kind == protocol.SPILLED:
+                    # Same-host spill file: restore by direct read.
+                    seg = self.shm.attach_path(descr[1])
+                else:
+                    seg = self.shm.attach(descr[1])
+            except FileNotFoundError:
+                # Raced with the owner's spiller (segment moved to disk) or
+                # a restore: the owner always knows the current location.
+                ok, reply = self._request(
+                    lambda rid: ("getparts", rid, tuple(descr)))
+                if not ok:
+                    raise self.materialize_error(reply)
+                return self.materialize(reply)
             self._segments.append(seg)
             return seg.deserialize()
         if kind == protocol.ERROR:
@@ -264,15 +277,23 @@ class _WorkerRuntime:
                 for i in range(spec["num_returns"])]
 
     def wait_objects(self, refs, num_returns, timeout, fetch_local):
-        reply = self._request(
-            lambda rid: (
-                "wait",
-                rid,
-                [r.id().binary() for r in refs],
-                num_returns,
-                timeout,
+        # Same blocked/unblocked envelope as get_objects: the lease's CPU
+        # slot is released while this worker sits in ray.wait, so tasks
+        # stolen off its pipeline (or anyone else) can actually run.
+        tid = self.current_task_id
+        self._send(("blocked", tid.binary() if tid else b""))
+        try:
+            reply = self._request(
+                lambda rid: (
+                    "wait",
+                    rid,
+                    [r.id().binary() for r in refs],
+                    num_returns,
+                    timeout,
+                )
             )
-        )
+        finally:
+            self._send(("unblocked", tid.binary() if tid else b""))
         ready_bin = set(reply)
         ready = [r for r in refs if r.id().binary() in ready_bin]
         not_ready = [r for r in refs if r.id().binary() not in ready_bin]

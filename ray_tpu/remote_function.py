@@ -77,7 +77,9 @@ def serialize_args(rt, args, kwargs, spec):
 
         oid = ObjectID.for_put()
         descr = rt.serialize_value(a, oid)
-        if descr[0] == "shm":
+        if descr[0] in ("shm", "spilled"):
+            # Ephemeral arg storage (segment name, or spill-file path when
+            # the store was full) — freed when the task / its lineage ends.
             tmp_segments.append((descr[1], descr[2]))
         return descr
 
