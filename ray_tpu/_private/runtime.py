@@ -358,7 +358,7 @@ class Runtime:
         self.lineage: Dict[bytes, dict] = {}
         self.functions: Dict[str, bytes] = {}
         self.worker_funcs: Dict[int, set] = {}  # conn fileno -> func_ids sent
-        self.task_events: deque = deque(maxlen=10000)
+        self.task_events: deque = deque(maxlen=200_000)
         self.events: Dict[str, deque] = {}  # topic -> payload bytes
         self._conn_to_worker: Dict[Any, WorkerHandle] = {}
         self._conn_to_agent: Dict[Any, AgentHandle] = {}
@@ -1015,6 +1015,12 @@ class Runtime:
                 # atomically with submission).
                 st.local_refs += 1
             self.tasks[spec["task_id"]] = rec
+            # SUBMITTED must precede the RUNNING event that dispatch may
+            # append below — state queries take the latest event per task.
+            self.task_events.append(
+                {"task_id": spec["task_id"].hex(),
+                 "name": spec.get("name"),
+                 "state": "SUBMITTED", "time": time.time()})
             self._register_lineage_locked(spec)
             self._pin_nested_locked(spec.get("nested_refs", []))
             self._resolve_deps_locked(rec)
@@ -1025,9 +1031,6 @@ class Runtime:
                 self._dispatch_locked()
         for i in range(spec["num_returns"]):
             refs.append(ObjectRef(tid.object_id(i), _register=False))
-        self.task_events.append(
-            {"task_id": spec["task_id"].hex(), "name": spec.get("name"),
-             "state": "SUBMITTED", "time": time.time()})
         return refs
 
     def _resolve_deps_locked(self, rec: TaskRecord):
@@ -1302,7 +1305,10 @@ class Runtime:
         else:
             # CPU-only workers must not grab the TPU runtime — and must not
             # pay the TPU-plugin import at interpreter startup either.
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            # Hard override (not setdefault): the driver may itself run
+            # under JAX_PLATFORMS=axon/tpu, which would crash in a worker
+            # whose tunnel env is stripped below.
+            env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env.pop("TPU_VISIBLE_CHIPS", None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -1949,6 +1955,13 @@ class Runtime:
                 worker.send(("reply", rid, actor_id))
             except Exception as e:  # noqa: BLE001
                 worker.send(("reply", rid, e))
+        elif tag == "state_req":
+            _, rid, kind, kwargs = msg
+            try:
+                worker.send(("reply", rid,
+                             self.state_query(kind, **kwargs)))
+            except Exception as e:  # noqa: BLE001
+                worker.send(("reply", rid, e))
         elif tag == "kill_actor_req":
             _, rid, actor_id, no_restart = msg
             self.kill_actor(actor_id, no_restart)
@@ -2077,6 +2090,10 @@ class Runtime:
                 fid = spec["func_id"]
                 self.functions.setdefault(fid, spec.pop("func_payload"))
             self.tasks[spec["task_id"]] = rec
+            self.task_events.append(
+                {"task_id": spec["task_id"].hex(),
+                 "name": spec.get("name"),
+                 "state": "SUBMITTED", "time": time.time()})
             self._register_lineage_locked(spec)
             self._pin_nested_locked(spec.get("nested_refs", []))
             self._resolve_deps_locked(rec)
@@ -2538,6 +2555,129 @@ class Runtime:
                 for k, v in n.available.items():
                     total[k] = total.get(k, 0.0) + v
             return total
+
+    def pending_resource_demand(self) -> List[Dict[str, float]]:
+        """Resource shapes of everything queued-but-unplaced: the
+        autoscaler's scale-up signal (reference: pending demand reported to
+        the monitor, resource_demand_scheduler.py)."""
+        with self.lock:
+            out: List[Dict[str, float]] = []
+            for q in self.pending_tasks.values():
+                for rec in q:
+                    if not rec.dispatched and not rec.cancelled:
+                        out.append(dict(rec.requirements))
+            for pg in self.pending_pgs:
+                out.extend(dict(b) for b in pg.bundles)
+            return out
+
+    def node_activity(self) -> List[Dict[str, Any]]:
+        """Per-node busy/idle for autoscaler scale-down decisions."""
+        with self.lock:
+            out = []
+            for node in self.nodes.values():
+                busy = any((w.inflight or w.actor_id is not None)
+                           and not w.dead
+                           for w in node.all_workers.values())
+                out.append({
+                    "node_id": node.node_id.hex(),
+                    "alive": node.alive,
+                    "is_head": node is self.head_node,
+                    "busy": busy,
+                    "resources": dict(node.resources),
+                    "available": dict(node.available),
+                })
+            return out
+
+    def state_query(self, kind: str, limit: int = 10000,
+                    **filters) -> list:
+        """State-observability reads over the authoritative tables
+        (reference: python/ray/experimental/state/api.py:738,961,1005 —
+        there an aggregator service queries GCS + raylets; here the tables
+        are driver-resident so this is a read under the lock)."""
+        if kind == "nodes":
+            return self.list_nodes()[:limit]
+        if kind == "actors":
+            with self.lock:
+                out = []
+                for aid, a in self.actors.items():
+                    out.append({
+                        "actor_id": aid.hex(),
+                        "state": a.status,
+                        "name": a.name,
+                        "class_name": a.options.get("class_name"),
+                        "node_id": (a.node.node_id.hex()
+                                    if a.node is not None else None),
+                        "pending_tasks": len(a.queue) + len(a.inflight),
+                        "restarts_left": a.restarts_left,
+                    })
+                return out[:limit]
+        if kind == "tasks":
+            # task_events is a bounded ring (latest event per id wins); the
+            # LIVE task table overlays it so queued/running tasks are
+            # always visible even if their events were evicted.
+            with self.lock:
+                latest: Dict[str, dict] = {}
+                for ev in self.task_events:
+                    latest[ev["task_id"]] = ev
+                for tid_bin, rec in self.tasks.items():
+                    tid = tid_bin.hex()
+                    st = "RUNNING" if rec.dispatched else "PENDING"
+                    cur = latest.get(tid)
+                    if cur is None or cur["state"] in ("SUBMITTED",
+                                                      "PENDING"):
+                        latest[tid] = {"task_id": tid,
+                                       "name": rec.spec.get("name"),
+                                       "state": st,
+                                       "time": time.time()}
+                out = [dict(ev) for ev in latest.values()]
+            return out[:limit]
+        if kind == "objects":
+            with self.lock:
+                status_names = {PENDING: "PENDING", READY: "READY",
+                                ERRORED: "ERRORED"}
+                out = []
+                for oid, st in self.objects.items():
+                    d = st.descr
+                    out.append({
+                        "object_id": oid.hex(),
+                        "state": status_names.get(st.status, "?"),
+                        "kind": (d[0] if d is not None else None),
+                        "size": (d[2] if d is not None
+                                 and d[0] in (protocol.SHM,
+                                              protocol.SPILLED)
+                                 else None),
+                        "local_refs": st.local_refs,
+                        "worker_refs": st.worker_refs,
+                        "pins": st.pins,
+                    })
+                return out[:limit]
+        if kind == "workers":
+            with self.lock:
+                out = []
+                for node in self.nodes.values():
+                    for w in node.all_workers.values():
+                        out.append({
+                            "worker_id": w.worker_id.hex(),
+                            "node_id": node.node_id.hex(),
+                            "alive": not w.dead,
+                            "actor_id": (w.actor_id.hex()
+                                         if w.actor_id else None),
+                            "inflight": len(w.inflight),
+                            "blocked": w.blocked,
+                        })
+                return out[:limit]
+        if kind == "placement_groups":
+            with self.lock:
+                return [{
+                    "placement_group_id": pg.pg_id.hex(),
+                    "name": pg.name,
+                    "strategy": pg.strategy,
+                    "bundles": list(pg.bundles),
+                    "reserved": [n.hex() if n is not None else None
+                                 for n in pg.reserved],
+                    "removed": pg.removed,
+                } for pg in self.placement_groups.values()][:limit]
+        raise ValueError(f"unknown state query kind {kind!r}")
 
     def list_nodes(self):
         with self.lock:

@@ -1,0 +1,14 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling (autoscaler v1
+equivalent).
+
+Reference: ``python/ray/autoscaler/_private/autoscaler.py:168``
+(StandardAutoscaler), ``resource_demand_scheduler.py`` (bin-packing), and
+the fake in-process provider the reference tests against
+(``_private/fake_multi_node/node_provider.py:237``).  TPU-native stance:
+nodes are slice-atomic — a TPU slice scales in and out as one unit.
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import FakeSliceProvider, NodeProvider
+
+__all__ = ["StandardAutoscaler", "NodeProvider", "FakeSliceProvider"]

@@ -1,0 +1,151 @@
+"""StandardAutoscaler: pending demand -> node-count decisions.
+
+Reference: ``python/ray/autoscaler/_private/autoscaler.py:168``
+(StandardAutoscaler.update: read load, bin-pack demand onto node types,
+launch/terminate) + ``resource_demand_scheduler.py`` (first-fit packing).
+Condensed: demand comes straight from the runtime's queued-but-unplaced
+shapes (`pending_resource_demand`), utilization from `node_activity`, and
+the loop either runs on a timer or is stepped manually (`update()`), which
+is how the reference tests it against the fake provider.
+
+Slice-atomicity is inherited from the provider: one launch == one whole
+TPU slice; scale-down terminates whole idle slices only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+def _fits(avail: Dict[str, float], shape: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v - 1e-9 for k, v in shape.items())
+
+
+def _take(avail: Dict[str, float], shape: Dict[str, float]):
+    for k, v in shape.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class StandardAutoscaler:
+    def __init__(self, runtime, provider: NodeProvider,
+                 idle_timeout_s: float = 10.0,
+                 update_interval_s: float = 2.0):
+        self._rt = runtime
+        self.provider = provider
+        self.idle_timeout_s = idle_timeout_s
+        self.update_interval_s = update_interval_s
+        self._idle_since: Dict[str, float] = {}
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- policy
+    def _unfulfilled_demand(self) -> List[Dict[str, float]]:
+        """Queued shapes that the current cluster cannot place even when
+        fully free — first-fit over every alive node's TOTAL resources
+        (reference: infeasible + backlog demand fed to the bin-packer)."""
+        demand = self._rt.pending_resource_demand()
+        if not demand:
+            return []
+        free = [dict(n["resources"]) for n in self._rt.node_activity()
+                if n["alive"]]
+        unfulfilled = []
+        for shape in sorted(demand, key=lambda s: -sum(s.values())):
+            for avail in free:
+                if _fits(avail, shape):
+                    _take(avail, shape)
+                    break
+            else:
+                unfulfilled.append(shape)
+        return unfulfilled
+
+    def _plan_launches(self, unfulfilled) -> Dict[str, int]:
+        """First-fit-decreasing the unfulfilled shapes onto fresh nodes of
+        each type (reference: resource_demand_scheduler.get_nodes_for)."""
+        launches: Dict[str, int] = {}
+        pools: List[Dict[str, float]] = []
+        counts = {t: len([n for n in self.provider.non_terminated_nodes()
+                          if self.provider.node_type_of(n) == t])
+                  for t in self.provider.node_types}
+        for shape in unfulfilled:
+            placed = False
+            for avail in pools:
+                if _fits(avail, shape):
+                    _take(avail, shape)
+                    placed = True
+                    break
+            if placed:
+                continue
+            # pick the first node type that can hold the shape at all
+            for t in self.provider.node_types:
+                res = self.provider.node_resources(t)
+                if _fits(res, shape) and \
+                        counts[t] + launches.get(t, 0) \
+                        < self.provider.max_workers(t):
+                    avail = dict(res)
+                    _take(avail, shape)
+                    pools.append(avail)
+                    launches[t] = launches.get(t, 0) + 1
+                    break
+            # shapes no type can hold stay infeasible (reference: warn)
+        return launches
+
+    def update(self) -> Dict[str, Any]:
+        """One reconcile tick: launch for unfulfilled demand, terminate
+        slices idle past the timeout.  Returns what it did."""
+        launched: List[str] = []
+        for node_type, n in self._plan_launches(
+                self._unfulfilled_demand()).items():
+            for _ in range(n):
+                launched.append(self.provider.create_node(node_type))
+        # scale-down: whole idle provider nodes only (never the head)
+        now = time.monotonic()
+        terminated: List[str] = []
+        activity = {a["node_id"]: a for a in self._rt.node_activity()}
+        # Only SATISFIABLE demand vetoes scale-down: a shape no alive node
+        # and no node type could ever hold must not pin idle slices.
+        demand_left = [
+            shape for shape in self._rt.pending_resource_demand()
+            if any(_fits(a["resources"], shape)
+                   for a in activity.values() if a["alive"])
+            or any(_fits(self.provider.node_resources(t), shape)
+                   for t in self.provider.node_types)]
+        for nid in list(self.provider.non_terminated_nodes()):
+            a = activity.get(nid)
+            if a is None or a["is_head"]:
+                continue
+            if a["busy"] or demand_left:
+                self._idle_since.pop(nid, None)
+                continue
+            first_idle = self._idle_since.setdefault(nid, now)
+            if now - first_idle >= self.idle_timeout_s:
+                self.provider.terminate_node(nid)
+                self._idle_since.pop(nid, None)
+                terminated.append(nid)
+        return {"launched": launched, "terminated": terminated}
+
+    # -------------------------------------------------------------- loop
+    def start(self):
+        """Background monitor loop (reference: monitor.py's driver)."""
+        if self._thread is not None:
+            return
+        self._stopped = False
+
+        def loop():
+            while not self._stopped:
+                time.sleep(self.update_interval_s)
+                try:
+                    self.update()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="ray_tpu-autoscaler")
+        self._thread.start()
+
+    def stop(self):
+        self._stopped = True
+        self._thread = None

@@ -1,0 +1,81 @@
+"""Node providers: the pluggable "how do I get a node" seam.
+
+Reference: ``python/ray/autoscaler/node_provider.py`` (the interface every
+cloud implements) and ``_private/fake_multi_node/node_provider.py:237``
+(the in-process fake the reference uses to test scale-up/down logic with
+no cloud).  TPU twist: nodes come in *slice-atomic* units — a TPU slice
+(e.g. v5e-4) joins or leaves as one node with all its chips; the provider
+never splits a slice.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Provider surface the autoscaler programs against: node-type
+    catalog + create/terminate/list + per-node type lookup."""
+
+    # name -> {"resources": {...}, "max_workers": int}
+    node_types: Dict[str, Dict[str, Any]] = {}
+
+    def create_node(self, node_type: str) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def node_resources(self, node_type: str) -> Dict[str, float]:
+        return dict(self.node_types[node_type]["resources"])
+
+    def max_workers(self, node_type: str) -> int:
+        return int(self.node_types[node_type].get("max_workers", 10))
+
+
+class FakeSliceProvider(NodeProvider):
+    """In-process provider over ``cluster_utils.Cluster``: each created
+    node is a REAL node_agent subprocess whose resources are one whole TPU
+    slice (or a CPU shape).  The autoscaler's decisions run end-to-end —
+    agents register, workers spawn there, objects move between stores —
+    with no cloud (reference: FakeMultiNodeProvider, node_provider.py:237).
+    """
+
+    def __init__(self, cluster, node_types: Dict[str, Dict[str, Any]]):
+        """node_types: name -> {"resources": {...}, "max_workers": int}.
+        A TPU slice type carries its whole chip count, e.g.
+        {"v5e-4": {"resources": {"CPU": 4, "TPU": 4}, "max_workers": 2}}.
+        """
+        self._cluster = cluster
+        self.node_types = node_types
+        self._nodes: Dict[str, str] = {}  # node_id_hex -> node_type
+
+    def create_node(self, node_type: str) -> str:
+        spec = self.node_types[node_type]
+        r = dict(spec["resources"])
+        num_cpus = r.pop("CPU", 1.0)
+        num_tpus = r.pop("TPU", 0.0)
+        node_id = self._cluster.add_node(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=r or None,
+            labels={"autoscaler_node_type": node_type}, external=True)
+        self._nodes[node_id] = node_type
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        self._nodes.pop(node_id, None)
+        self._cluster.remove_node(node_id)
+
+    def non_terminated_nodes(self) -> List[str]:
+        alive = {n["node_id"] for n in self._cluster.rt.list_nodes()
+                 if n["alive"]}
+        return [nid for nid in self._nodes if nid in alive]
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        return self._nodes.get(node_id)

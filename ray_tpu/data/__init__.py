@@ -4,16 +4,19 @@ Reference: ``python/ray/data/`` (SURVEY.md §2.3, 35k LoC) — Dataset over
 Arrow blocks living in the object store, lazy ExecutionPlan, bulk + streaming
 executors, datasource plugins, split() feeding Train shards.
 
-Condensation here: blocks are object-store refs holding lists-of-rows or
-dict-of-numpy "tensor blocks"; the plan is a lazy op chain executed by a
-bulk executor (one task per block per op — streaming executor is a later
-round); IO goes through pyarrow (parquet/csv/json).  The Train integration
-contract is the same: ``ds.split(k)`` -> per-worker shards,
-``shard.iter_batches()`` inside the train loop.
+Condensation here: blocks are object-store refs holding lists-of-rows,
+dict-of-numpy "tensor blocks", or pyarrow Tables; transforms build a lazy
+fused-op plan executed by a bounded-in-flight streaming executor
+(``streaming_executor.py:35`` analog); split/repartition plan row ranges
+and cut blocks with tasks (no driver materialization); IO goes through
+pyarrow (parquet/csv/json).  The Train integration contract is the same:
+``ds.split(k)`` -> per-worker shards, ``shard.iter_batches()`` inside the
+train loop.
 """
 
 from ray_tpu.data.dataset import (
     Dataset,
+    from_arrow,
     from_items,
     from_numpy,
     from_pandas,
@@ -29,6 +32,6 @@ from ray_tpu.data.dataset import (
 range = range_
 
 __all__ = [
-    "Dataset", "from_items", "from_numpy", "from_pandas", "range",
-    "read_csv", "read_json", "read_parquet", "read_text",
+    "Dataset", "from_arrow", "from_items", "from_numpy", "from_pandas",
+    "range", "read_csv", "read_json", "read_parquet", "read_text",
 ]

@@ -1,17 +1,34 @@
-"""Dataset: lazy op-chain over object-store blocks.
+"""Dataset: lazy fused-op plan over object-store blocks, executed by a
+bounded-in-flight streaming executor.
 
 Reference: ``python/ray/data/dataset.py:166`` (4.5k LoC Dataset),
-``_internal/plan.py`` (ExecutionPlan), ``_internal/execution/bulk_executor
-.py:20``.  Execution model kept: a Dataset is (block refs, lazy ops); ops
-are applied block-parallel as tasks at materialization; consumed via
-iter_rows/iter_batches/take/write_* or split() into Train shards.
+``_internal/plan.py`` (ExecutionPlan), and the streaming executor
+(``_internal/execution/streaming_executor.py:35``).  Three properties kept
+from the reference's model, re-designed small:
+
+- **Lazy plan + operator fusion**: transforms append ops to a plan; at
+  execution one task per block applies the whole fused chain (the
+  reference fuses compatible map-like operators the same way).
+- **Streaming with backpressure**: consumers pull block refs through a
+  sliding window of at most ``max_in_flight`` concurrent block tasks, so
+  a dataset larger than driver RAM streams through without materializing
+  (``streaming_executor.py`` bounded resource admission).
+- **No driver materialization for layout ops**: ``split``/``repartition``
+  plan row ranges from per-block counts and cut blocks with tasks —
+  rows move store-to-store, never through the driver (the round-2
+  ``take_all`` versions bounded pipelines by driver RAM).
+
+Blocks are lists of rows, dict-of-numpy "tensor blocks", or
+``pyarrow.Table`` (tabular zero-copy path, ``_internal/arrow_block.py``
+analog).
 """
 
 from __future__ import annotations
 
 import builtins
 import itertools
-from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+from collections import deque
+from typing import Any, Callable, Iterator, List, Optional, Union
 
 import numpy as np
 
@@ -19,10 +36,21 @@ import ray_tpu as ray
 
 
 # --------------------------------------------------------------- block ops
-# A block is a list of rows (dicts or scalars) or a dict-of-numpy arrays
-# ("tensor block").  Ops below run inside tasks (block-parallel).
+# A block is a list of rows (dicts or scalars), a dict-of-numpy arrays
+# ("tensor block"), or a pyarrow.Table.  Ops below run inside tasks.
+
+def _is_arrow(block) -> bool:
+    try:
+        import pyarrow as pa
+
+        return isinstance(block, pa.Table)
+    except ImportError:
+        return False
+
 
 def _block_len(block) -> int:
+    if _is_arrow(block):
+        return block.num_rows
     if isinstance(block, dict):
         for v in block.values():
             return len(v)
@@ -31,6 +59,9 @@ def _block_len(block) -> int:
 
 
 def _block_rows(block) -> Iterator[Any]:
+    if _is_arrow(block):
+        yield from block.to_pylist()
+        return
     if isinstance(block, dict):
         keys = list(block)
         for i in builtins.range(_block_len(block)):
@@ -39,53 +70,87 @@ def _block_rows(block) -> Iterator[Any]:
         yield from block
 
 
-def _rows_to_block(rows: List[Any]):
+def _slice_rows(block, start: int, stop: int):
+    """Row-range cut of any block kind, zero-copy where the format allows
+    (arrow slice / numpy views)."""
+    if _is_arrow(block):
+        return block.slice(start, stop - start)
+    if isinstance(block, dict):
+        return {k: v[start:stop] for k, v in block.items()}
+    return block[start:stop]
+
+
+def _format_batch(rows: List[Any], batch_format: str):
+    if batch_format == "numpy":
+        if rows and isinstance(rows[0], dict):
+            return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+        return np.asarray(rows)
+    if batch_format == "pandas":
+        import pandas as pd
+
+        return pd.DataFrame(rows)
+    if batch_format == "pyarrow":
+        import pyarrow as pa
+
+        return pa.Table.from_pylist(rows)
     return rows
 
 
-@ray.remote
-def _map_block(fn, block):
-    return _rows_to_block([fn(r) for r in _block_rows(block)])
-
-
-@ray.remote
-def _filter_block(fn, block):
-    return _rows_to_block([r for r in _block_rows(block) if fn(r)])
-
-
-@ray.remote
-def _flat_map_block(fn, block):
-    out = []
-    for r in _block_rows(block):
-        out.extend(fn(r))
-    return _rows_to_block(out)
-
-
-@ray.remote
-def _map_batches_block(fn, block, batch_format):
-    rows = list(_block_rows(block))
-    if batch_format == "numpy":
-        if rows and isinstance(rows[0], dict):
-            batch = {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
-        else:
-            batch = np.asarray(rows)
-    elif batch_format == "pandas":
-        import pandas as pd
-        batch = pd.DataFrame(rows)
-    else:
-        batch = rows
-    out = fn(batch)
-    if isinstance(out, dict):
+def _apply_op(op, block):
+    """One fused-plan step applied to a whole block (runs inside a task)."""
+    kind, arg = op[0], op[1]
+    if kind == "map":
+        return [arg(r) for r in _block_rows(block)]
+    if kind == "filter":
+        return [r for r in _block_rows(block) if arg(r)]
+    if kind == "flat_map":
+        out = []
+        for r in _block_rows(block):
+            out.extend(arg(r))
         return out
-    try:
-        import pandas as pd
-        if isinstance(out, pd.DataFrame):
-            return out.to_dict("records")
-    except ImportError:
-        pass
-    if isinstance(out, np.ndarray):
+    if kind == "map_batches":
+        batch_format = op[2]
+        # Fast paths keep the native block kind (no row materialization);
+        # everything else goes rows -> _format_batch.
+        if batch_format == "pyarrow" and _is_arrow(block):
+            batch = block
+        elif batch_format == "numpy" and isinstance(block, dict):
+            batch = block
+        else:
+            batch = _format_batch(list(_block_rows(block)), batch_format)
+        out = arg(batch)
+        # Any block kind may come back: arrow Table, dict-of-numpy, list,
+        # ndarray, or DataFrame.
+        if _is_arrow(out) or isinstance(out, dict):
+            return out
+        try:
+            import pandas as pd
+
+            if isinstance(out, pd.DataFrame):
+                return out.to_dict("records")
+        except ImportError:
+            pass
+        if isinstance(out, np.ndarray):
+            return list(out)
         return list(out)
-    return list(out)
+    raise ValueError(f"unknown op {kind!r}")
+
+
+@ray.remote
+def _apply_plan(ops, block):
+    for op in ops:
+        block = _apply_op(op, block)
+    return block
+
+
+@ray.remote
+def _count_block(block):
+    return _block_len(block)
+
+
+@ray.remote
+def _slice_block(block, start, stop):
+    return _slice_rows(block, start, stop)
 
 
 @ray.remote
@@ -98,9 +163,10 @@ def _sort_block(block, key, descending):
 @ray.remote
 def _merge_sorted(key, descending, *blocks):
     import heapq
-    keyfn = (lambda r: r[key]) if isinstance(key, str) else (key or (lambda r: r))
-    rows = list(heapq.merge(*blocks, key=keyfn, reverse=descending))
-    return rows
+
+    keyfn = (lambda r: r[key]) if isinstance(key, str) \
+        else (key or (lambda r: r))
+    return list(heapq.merge(*blocks, key=keyfn, reverse=descending))
 
 
 @ray.remote
@@ -120,41 +186,165 @@ def _shuffle_reduce(seed, *parts):
     return rows
 
 
-class Dataset:
-    """Immutable, lazily-transformed distributed collection."""
+# Concurrent block tasks per consuming iterator — the streaming window
+# (reference: resource-budgeted admission in streaming_executor.py:35).
+DEFAULT_STREAMING_WINDOW = 8
 
-    def __init__(self, block_refs: List[Any]):
-        self._blocks = list(block_refs)
+
+class Dataset:
+    """Immutable, lazily-transformed distributed collection.
+
+    Internally a list of *segments* — (block_refs, fused op chain) pairs —
+    so ``union`` of differently-transformed datasets stays lazy: nothing
+    submits until a consumer pulls through the streaming window."""
+
+    def __init__(self, block_refs: List[Any], ops: tuple = ()):
+        self._segments: List[tuple] = [(list(block_refs), tuple(ops))]
+        # Executed-block memo: consuming the same Dataset twice must not
+        # re-run its UDF tasks (filled only when a consumer drains the
+        # whole stream; partial reads like take/limit leave it unset).
+        self._cached_refs: Optional[List[Any]] = None
+
+    @classmethod
+    def _from_segments(cls, segments: List[tuple]) -> "Dataset":
+        ds = cls([])
+        ds._segments = [(list(b), tuple(o)) for b, o in segments]
+        return ds
+
+    @property
+    def _blocks(self) -> List[Any]:
+        return [b for blocks, _ in self._segments for b in blocks]
+
+    @property
+    def _ops(self) -> tuple:
+        # Uniform-plan view (tests / introspection); multi-segment datasets
+        # report the first segment's ops.
+        return self._segments[0][1] if self._segments else ()
 
     # ------------------------------------------------------------ transforms
+    def _with_op(self, op) -> "Dataset":
+        return Dataset._from_segments(
+            [(blocks, ops + (op,)) for blocks, ops in self._segments])
+
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
-        return Dataset([_map_block.remote(fn, b) for b in self._blocks])
+        return self._with_op(("map", fn))
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
-        return Dataset([_filter_block.remote(fn, b) for b in self._blocks])
+        return self._with_op(("filter", fn))
 
     def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
-        return Dataset([_flat_map_block.remote(fn, b) for b in self._blocks])
+        return self._with_op(("flat_map", fn))
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy"
                     ) -> "Dataset":
-        return Dataset([_map_batches_block.remote(fn, b, batch_format)
-                        for b in self._blocks])
+        return self._with_op(("map_batches", fn, batch_format))
 
+    # ------------------------------------------------------------- execution
+    def _stream_refs(self, window: Optional[int] = None) -> Iterator[Any]:
+        """Yield executed block refs in order, keeping at most ``window``
+        block tasks in flight — the streaming executor.  Blocks with no
+        pending ops pass straight through.  A fully-drained stream
+        memoizes its refs so repeat consumption reuses the results."""
+        if self._cached_refs is not None:
+            yield from self._cached_refs
+            return
+        window = window or DEFAULT_STREAMING_WINDOW
+        pairs = ((b, ops) for blocks, ops in self._segments
+                 for b in blocks)
+
+        def submit(pair):
+            b, ops = pair
+            return b if not ops else _apply_plan.remote(ops, b)
+
+        dq: deque = deque()
+        it = iter(pairs)
+        for pair in itertools.islice(it, window):
+            dq.append(submit(pair))
+        produced: List[Any] = []
+        while dq:
+            head = dq.popleft()
+            ray.wait([head], num_returns=1, timeout=None)
+            nxt = next(it, None)
+            if nxt is not None:
+                dq.append(submit(nxt))
+            produced.append(head)
+            yield head
+        self._cached_refs = produced
+
+    def materialize(self) -> "Dataset":
+        """Execute the plan fully; the result holds plain block refs
+        (reference: Dataset.materialize)."""
+        if self._cached_refs is not None:
+            return Dataset(self._cached_refs)
+        if all(not ops for _, ops in self._segments):
+            return self
+        self._cached_refs = [
+            (b if not ops else _apply_plan.remote(ops, b))
+            for blocks, ops in self._segments for b in blocks]
+        return Dataset(self._cached_refs)
+
+    def _executed_refs(self) -> List[Any]:
+        return self.materialize()._blocks
+
+    # -------------------------------------------------------- layout ops
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self.take_all()
-        return from_items(rows, parallelism=num_blocks)
+        """Rebalance into ``num_blocks`` row-equal blocks with slice tasks —
+        rows never pass through the driver (reference: repartition via
+        shuffle/split_at_indices, not driver collect)."""
+        blocks = self._executed_refs()
+        counts = ray.get([_count_block.remote(b) for b in blocks])
+        total = sum(counts)
+        num_blocks = max(1, num_blocks)
+        bounds = [total * (i + 1) // num_blocks
+                  for i in builtins.range(num_blocks)]
+        plans = _plan_row_ranges(counts, bounds)
+        out = []
+        for plan in plans:
+            if len(plan) == 1:
+                bi, s, e = plan[0]
+                out.append(_slice_block.remote(blocks[bi], s, e)
+                           if (s, e) != (0, counts[bi])
+                           else blocks[bi])
+            else:
+                out.append(_concat_slices.remote(
+                    [(i, s, e) for i, s, e in plan],
+                    *[blocks[bi] for bi, _, _ in plan]))
+        return Dataset(out)
+
+    def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
+        """Shard for Train workers without driver materialization
+        (reference: dataset.py split + Train dataset_spec.py).  Each shard
+        is a lazy Dataset over sliced block refs; Train workers consume
+        them via iter_batches inside their own processes."""
+        blocks = self._executed_refs()
+        if not equal:
+            return [Dataset(blocks[i::n]) for i in builtins.range(n)]
+        counts = ray.get([_count_block.remote(b) for b in blocks])
+        total = sum(counts)
+        per = total // n
+        bounds = [per * (i + 1) for i in builtins.range(n)]
+        plans = _plan_row_ranges(counts, bounds)
+        out = []
+        for plan in plans:
+            refs = []
+            for bi, s, e in plan:
+                if e > s:
+                    refs.append(blocks[bi] if (s, e) == (0, counts[bi])
+                                else _slice_block.remote(blocks[bi], s, e))
+            out.append(Dataset(refs))
+        return out
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Push-based two-stage shuffle (reference:
         _internal/push_based_shuffle.py): map tasks partition rows to
         reducers; reduce tasks concat + locally shuffle."""
-        n = len(self._blocks)
+        blocks = self._executed_refs()
+        n = len(blocks)
         if n == 0:
-            return self
+            return Dataset([])
         seed = 0 if seed is None else seed
         parts = [_shuffle_map.options(num_returns=n).remote(b, n, seed + i)
-                 for i, b in enumerate(self._blocks)]
+                 for i, b in enumerate(blocks)]
         if n == 1:
             parts = [[p] for p in parts]
         reducers = []
@@ -165,65 +355,58 @@ class Dataset:
 
     def sort(self, key: Union[str, Callable, None] = None,
              descending: bool = False) -> "Dataset":
+        blocks = self._executed_refs()
         sorted_blocks = [_sort_block.remote(b, key, descending)
-                         for b in self._blocks]
+                         for b in blocks]
         merged = _merge_sorted.remote(key, descending, *sorted_blocks)
         return Dataset([merged])
 
     def union(self, *others: "Dataset") -> "Dataset":
-        blocks = list(self._blocks)
+        """Lazy concatenation: segments are appended, not executed — the
+        streaming window still governs when block tasks run."""
+        segments = list(self._segments)
         for o in others:
-            blocks.extend(o._blocks)
-        return Dataset(blocks)
+            segments.extend(o._segments)
+        return Dataset._from_segments(segments)
 
     def limit(self, n: int) -> "Dataset":
-        rows = []
-        for b in self._blocks:
-            rows.extend(_block_rows(ray.get(b)))
-            if len(rows) >= n:
+        """First n rows; executes only as many blocks as needed (streaming
+        early-exit)."""
+        taken, refs = 0, []
+        for ref in self._stream_refs():
+            cnt = ray.get(_count_block.remote(ref))
+            if taken + cnt <= n:
+                refs.append(ref)
+                taken += cnt
+            else:
+                refs.append(_slice_block.remote(ref, 0, n - taken))
+                taken = n
+            if taken >= n:
                 break
-        return from_items(rows[:n], parallelism=1)
-
-    def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
-        """Shard for Train workers (reference: dataset.py split + Train
-        dataset_spec.py)."""
-        rows = self.take_all()
-        if equal:
-            per = len(rows) // n
-            return [from_items(rows[i * per:(i + 1) * per], parallelism=1)
-                    for i in builtins.range(n)]
-        sizes = [len(rows) // n + (1 if i < len(rows) % n else 0)
-                 for i in builtins.range(n)]
-        out, cur = [], 0
-        for s in sizes:
-            out.append(from_items(rows[cur:cur + s], parallelism=1))
-            cur += s
-        return out
+        return Dataset(refs)
 
     # ------------------------------------------------------------ consumers
     def count(self) -> int:
-        @ray.remote
-        def _len(b):
-            return _block_len(b)
-        return sum(ray.get([_len.remote(b) for b in self._blocks]))
+        return sum(ray.get([_count_block.remote(r)
+                            for r in self._stream_refs()]))
 
     def take(self, n: int = 20) -> List[Any]:
         out = []
-        for b in self._blocks:
-            out.extend(_block_rows(ray.get(b)))
+        for ref in self._stream_refs():
+            out.extend(_block_rows(ray.get(ref)))
             if len(out) >= n:
                 return out[:n]
         return out
 
     def take_all(self) -> List[Any]:
         out = []
-        for b in ray.get(list(self._blocks)):
-            out.extend(_block_rows(b))
+        for ref in self._stream_refs():
+            out.extend(_block_rows(ray.get(ref)))
         return out
 
     def iter_rows(self) -> Iterator[Any]:
-        for b in self._blocks:
-            yield from _block_rows(ray.get(b))
+        for ref in self._stream_refs():
+            yield from _block_rows(ray.get(ref))
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy",
@@ -251,7 +434,7 @@ class Dataset:
 
     def sum(self, key: Optional[str] = None):
         vals = (r[key] if key else r for r in self.iter_rows())
-        return sum(vals)
+        return builtins.sum(vals)
 
     def mean(self, key: Optional[str] = None):
         total, n = 0.0, 0
@@ -262,23 +445,31 @@ class Dataset:
 
     # ------------------------------------------------------------------- IO
     def write_parquet(self, path: str):
+        import os
+
         import pyarrow as pa
         import pyarrow.parquet as pq
-        import os
+
         os.makedirs(path, exist_ok=True)
-        for i, b in enumerate(self._blocks):
-            rows = list(_block_rows(ray.get(b)))
-            if not rows:
-                continue
-            table = pa.Table.from_pylist(rows)
+        for i, ref in enumerate(self._stream_refs()):
+            block = ray.get(ref)
+            if _is_arrow(block):
+                table = block
+            else:
+                rows = list(_block_rows(block))
+                if not rows:
+                    continue
+                table = pa.Table.from_pylist(rows)
             pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
 
     def write_csv(self, path: str):
-        import pandas as pd
         import os
+
+        import pandas as pd
+
         os.makedirs(path, exist_ok=True)
-        for i, b in enumerate(self._blocks):
-            rows = list(_block_rows(ray.get(b)))
+        for i, ref in enumerate(self._stream_refs()):
+            rows = list(_block_rows(ray.get(ref)))
             if rows:
                 pd.DataFrame(rows).to_csv(
                     os.path.join(path, f"part-{i:05d}.csv"), index=False)
@@ -286,26 +477,52 @@ class Dataset:
     def write_json(self, path: str):
         import json
         import os
+
         os.makedirs(path, exist_ok=True)
-        for i, b in enumerate(self._blocks):
-            rows = list(_block_rows(ray.get(b)))
+        for i, ref in enumerate(self._stream_refs()):
+            rows = list(_block_rows(ray.get(ref)))
             with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
                 for r in rows:
                     f.write(json.dumps(r) + "\n")
 
     def __repr__(self):
-        return f"Dataset(num_blocks={len(self._blocks)})"
+        ops = "->".join(op[0] for op in self._ops)
+        extra = (f", segments={len(self._segments)}"
+                 if len(self._segments) > 1 else "")
+        return (f"Dataset(num_blocks={len(self._blocks)}"
+                + (f", plan={ops}" if ops else "") + extra + ")")
 
 
-def _format_batch(rows: List[Any], batch_format: str):
-    if batch_format == "numpy":
-        if rows and isinstance(rows[0], dict):
-            return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
-        return np.asarray(rows)
-    if batch_format == "pandas":
-        import pandas as pd
-        return pd.DataFrame(rows)
+@ray.remote
+def _concat_slices(ranges, *blocks):
+    rows = []
+    for (bi, s, e), block in zip(ranges, blocks):
+        rows.extend(_block_rows(_slice_rows(block, s, e)))
     return rows
+
+
+def _plan_row_ranges(counts: List[int], bounds: List[int]):
+    """Cut blocks with ``counts`` rows at global row ``bounds`` →
+    per-output-partition lists of (block_idx, start, stop)."""
+    plans: List[List[tuple]] = []
+    bi, offset = 0, 0  # position in input blocks
+    prev = 0
+    for bound in bounds:
+        want = bound - prev
+        plan: List[tuple] = []
+        while want > 0 and bi < len(counts):
+            avail = counts[bi] - offset
+            take = min(want, avail)
+            if take > 0:
+                plan.append((bi, offset, offset + take))
+            offset += take
+            want -= take
+            if offset >= counts[bi]:
+                bi += 1
+                offset = 0
+        plans.append(plan)
+        prev = bound
+    return plans
 
 
 # ------------------------------------------------------------ constructors
@@ -330,16 +547,27 @@ def from_pandas(df, *, parallelism: int = 8) -> Dataset:
     return from_items(df.to_dict("records"), parallelism=parallelism)
 
 
+def from_arrow(table, *, parallelism: int = 8) -> Dataset:
+    """Blocks are pyarrow.Table slices — the tabular zero-copy path
+    (reference: _internal/arrow_block.py)."""
+    n = max(1, min(parallelism, table.num_rows) or 1)
+    per = (table.num_rows + n - 1) // n
+    blocks = [ray.put(table.slice(i * per, per)) for i in builtins.range(n)]
+    return Dataset(blocks)
+
+
 def read_parquet(path: str, *, parallelism: int = 8) -> Dataset:
     import glob
     import os
+
     files = sorted(glob.glob(os.path.join(path, "*.parquet"))) \
         if os.path.isdir(path) else [path]
 
     @ray.remote
     def _load(f):
         import pyarrow.parquet as pq
-        return pq.read_table(f).to_pylist()
+
+        return pq.read_table(f)  # arrow Table block, zero-copy downstream
 
     return Dataset([_load.remote(f) for f in files])
 
@@ -347,12 +575,14 @@ def read_parquet(path: str, *, parallelism: int = 8) -> Dataset:
 def read_csv(path: str, *, parallelism: int = 8) -> Dataset:
     import glob
     import os
+
     files = sorted(glob.glob(os.path.join(path, "*.csv"))) \
         if os.path.isdir(path) else [path]
 
     @ray.remote
     def _load(f):
         import pandas as pd
+
         return pd.read_csv(f).to_dict("records")
 
     return Dataset([_load.remote(f) for f in files])
@@ -361,12 +591,14 @@ def read_csv(path: str, *, parallelism: int = 8) -> Dataset:
 def read_json(path: str, *, parallelism: int = 8) -> Dataset:
     import glob
     import os
+
     files = sorted(glob.glob(os.path.join(path, "*.json"))) \
         if os.path.isdir(path) else [path]
 
     @ray.remote
     def _load(f):
         import json
+
         with open(f) as fh:
             return [json.loads(line) for line in fh if line.strip()]
 
@@ -376,6 +608,7 @@ def read_json(path: str, *, parallelism: int = 8) -> Dataset:
 def read_text(path: str, *, parallelism: int = 8) -> Dataset:
     import glob
     import os
+
     files = sorted(glob.glob(path)) if any(c in path for c in "*?") \
         else ([os.path.join(path, f) for f in sorted(os.listdir(path))]
               if os.path.isdir(path) else [path])

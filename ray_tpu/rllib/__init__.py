@@ -4,8 +4,9 @@ Reference: ``rllib/`` (SURVEY.md §2.4, 175k LoC).  The TPU build implements
 the *new Learner stack* the reference was migrating to (``rllib/core/learner``,
 SURVEY.md: "the TPU build should implement this stack rather than the legacy
 Policy-GPU path"): CPU rollout-worker actors feed a JAX Learner whose update
-is one jitted program on the TPU mesh.  Algorithms: PPO (sync on-policy) and
-IMPALA (async, V-trace in XLA) — the reference's two flagship algorithms.
+is one jitted program on the TPU mesh.  Algorithms: PPO (sync on-policy),
+IMPALA (async, V-trace in XLA), and DQN (off-policy, prioritized replay +
+double-Q + target network, the Ape-X worker->replay-actor arrangement).
 """
 
 from ray_tpu.rllib.sample_batch import SampleBatch, concat_batches
@@ -15,9 +16,15 @@ from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.env import VectorEnv
+from ray_tpu.rllib.replay_buffers import (
+    PrioritizedReplayBuffer, ReplayActor, ReplayBuffer,
+)
 
 __all__ = [
     "SampleBatch", "concat_batches", "ActorCriticMLP", "RolloutWorker",
     "WorkerSet", "Learner", "LearnerGroup", "Algorithm", "AlgorithmConfig",
-    "PPO", "PPOConfig", "Impala", "ImpalaConfig",
+    "PPO", "PPOConfig", "Impala", "ImpalaConfig", "DQN", "DQNConfig",
+    "VectorEnv", "ReplayBuffer", "PrioritizedReplayBuffer", "ReplayActor",
 ]

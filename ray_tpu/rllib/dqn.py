@@ -1,0 +1,300 @@
+"""DQN: off-policy Q-learning with prioritized replay and a target network.
+
+Reference: ``rllib/algorithms/dqn/dqn.py`` (``training_step``: sample ->
+store in replay -> train on prioritized batches -> update priorities ->
+periodic target sync) with double-Q (van Hasselt) as the reference's
+default.  TPU division of labor matches the rest of the stack: CPU
+rollout workers act epsilon-greedily and push transitions straight to a
+ReplayActor (the Ape-X arrangement); the learner's update is one jitted
+program on the device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu as ray
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import VectorEnv
+from ray_tpu.rllib.replay_buffers import (
+    BATCH_INDEXES, WEIGHTS, ReplayActor,
+)
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch,
+)
+
+
+class QNetworkMLP:
+    """obs -> Q(s, ·) MLP (reference: the default dueling-off q-model)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden=(64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, key):
+        sizes = (self.obs_dim,) + self.hidden + (self.num_actions,)
+        params = []
+        for a, b in zip(sizes[:-1], sizes[1:]):
+            key, k = jax.random.split(key)
+            params.append({"w": jax.random.normal(k, (a, b))
+                           * np.sqrt(2.0 / a),
+                           "b": jnp.zeros((b,))})
+        return params
+
+    def apply(self, params, obs):
+        x = obs
+        for i, lyr in enumerate(params):
+            x = x @ lyr["w"] + lyr["b"]
+            if i < len(params) - 1:
+                x = jnp.tanh(x)
+        return x  # (B, num_actions)
+
+
+@ray.remote
+class DQNRolloutWorker:
+    """Epsilon-greedy vectorized rollouts pushed directly to the replay
+    actor (reference: Ape-X workers writing to replay shards)."""
+
+    def __init__(self, env_maker, model_config: Dict[str, Any],
+                 replay_actor, num_envs: int = 1, worker_index: int = 0,
+                 seed: Optional[int] = None):
+        self._venv = VectorEnv(env_maker, num_envs,
+                               seed=(seed if seed is not None
+                                     else worker_index))
+        self._model = QNetworkMLP(**model_config)
+        self._params = None
+        self._replay = replay_actor
+        self._rng = np.random.default_rng(
+            seed if seed is not None else worker_index)
+        self._obs = self._venv.vector_reset()
+        self._apply = jax.jit(self._model.apply)
+        self._ep_returns = np.zeros(num_envs)
+        self._completed: List[float] = []
+
+    def set_weights(self, weights):
+        self._params = weights
+        return True
+
+    def sample(self, num_steps: int, epsilon: float) -> int:
+        """Step envs for ``num_steps``; push transitions to replay.
+        Returns env-steps collected."""
+        assert self._params is not None, "set_weights first"
+        n = self._venv.num_envs
+        cols = {k: [] for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, DONES)}
+        for _ in range(num_steps):
+            q = np.asarray(self._apply(self._params, self._obs))
+            acts = q.argmax(axis=-1)
+            explore = self._rng.random(n) < epsilon
+            acts = np.where(
+                explore,
+                self._rng.integers(0, q.shape[-1], size=n), acts)
+            next_obs, rews, terms, truncs, finals, _ = \
+                self._venv.vector_step(acts)
+            cols[OBS].append(self._obs)
+            cols[ACTIONS].append(acts)
+            cols[REWARDS].append(rews)
+            cols[NEXT_OBS].append(finals)  # pre-reset obs for bootstrap
+            # DONES carries TERMINATION only: a time-limit truncation must
+            # still bootstrap gamma*Q(final_obs) in the TD target.
+            cols[DONES].append(terms)
+            self._ep_returns += rews
+            for i in np.nonzero(terms | truncs)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            self._obs = next_obs
+        batch = SampleBatch({
+            OBS: np.concatenate(cols[OBS]).astype(np.float32),
+            ACTIONS: np.concatenate(cols[ACTIONS]).astype(np.int32),
+            REWARDS: np.concatenate(cols[REWARDS]).astype(np.float32),
+            NEXT_OBS: np.concatenate(cols[NEXT_OBS]).astype(np.float32),
+            DONES: np.concatenate(cols[DONES]),
+        })
+        ray.get(self._replay.add.remote(dict(batch)))
+        return len(batch)
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._completed)
+        if clear:
+            self._completed.clear()
+        return out
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 64
+        self.replay_buffer_capacity = 100_000
+        self.prioritized_replay = True
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        self.target_network_update_freq = 500   # env steps
+        self.num_steps_sampled_before_learning = 1000
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 10_000
+        self.double_q = True
+        self.num_train_batches_per_step = 16
+        self.grad_clip = 10.0
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+def dqn_loss(params, target_params, module, batch, *, gamma: float,
+             double_q: bool):
+    """Double-DQN TD loss with importance weights; returns per-item TD
+    errors for priority updates (reference: dqn_torch_policy.py)."""
+    q = module.apply(params, batch[OBS])
+    q_sa = jnp.take_along_axis(
+        q, batch[ACTIONS][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    q_next_target = module.apply(target_params, batch[NEXT_OBS])
+    if double_q:
+        q_next_online = module.apply(params, batch[NEXT_OBS])
+        next_a = q_next_online.argmax(axis=-1)
+        q_next = jnp.take_along_axis(
+            q_next_target, next_a[:, None], axis=-1)[:, 0]
+    else:
+        q_next = q_next_target.max(axis=-1)
+    not_done = 1.0 - batch[DONES].astype(jnp.float32)
+    target = batch[REWARDS] + gamma * not_done * q_next
+    td = q_sa - jax.lax.stop_gradient(target)
+    loss = jnp.mean(batch[WEIGHTS] * jnp.square(td))
+    return loss, {"td_errors": td, "mean_q": jnp.mean(q_sa)}
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def _setup(self, cfg: DQNConfig):
+        env = cfg.env_maker()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        if hasattr(env, "close"):
+            env.close()
+        model_config = {"obs_dim": obs_dim, "num_actions": num_actions,
+                        "hidden": tuple(cfg.model.get("hidden", (64, 64)))}
+        self.module = QNetworkMLP(**model_config)
+        self.replay = ReplayActor.options(num_cpus=1).remote(
+            capacity=cfg.replay_buffer_capacity,
+            alpha=cfg.prioritized_replay_alpha,
+            prioritized=cfg.prioritized_replay, seed=cfg.seed)
+        self.workers = [
+            DQNRolloutWorker.options(num_cpus=1).remote(
+                cfg.env_maker, model_config, self.replay,
+                num_envs=cfg.num_envs_per_worker, worker_index=i, seed=i)
+            for i in range(cfg.num_rollout_workers)]
+        self.params = self.module.init(jax.random.PRNGKey(cfg.seed))
+        # Real copies, not identity: params buffers are DONATED on update,
+        # and an aliasing target would hold invalidated buffers.
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip), optax.adam(cfg.lr))
+        self._opt_state = self._optimizer.init(self.params)
+        module = self.module
+
+        def _update(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                dqn_loss, has_aux=True)(
+                    params, target_params, module, batch,
+                    gamma=cfg.gamma, double_q=cfg.double_q)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(_update, donate_argnums=(0, 2))
+        self._steps_sampled = 0
+        self._steps_since_target_sync = 0
+        self._sync_worker_weights()
+
+    def _sync_worker_weights(self):
+        w = jax.device_get(self.params)
+        ray.get([wk.set_weights.remote(w) for wk in self.workers])
+
+    def _epsilon(self) -> float:
+        cfg: DQNConfig = self.algo_config
+        frac = min(1.0, self._steps_sampled / max(cfg.epsilon_timesteps, 1))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: DQNConfig = self.algo_config
+        # 1. rollouts at the current epsilon -> replay actor
+        eps = self._epsilon()
+        steps = ray.get([w.sample.remote(cfg.rollout_fragment_length, eps)
+                         for w in self.workers])
+        self._steps_sampled += sum(steps)
+        self._steps_since_target_sync += sum(steps)
+        metrics: Dict[str, Any] = {"epsilon": eps,
+                                   "num_env_steps_sampled":
+                                       self._steps_sampled}
+        # 2. learn from prioritized batches once warm
+        if self._steps_sampled >= cfg.num_steps_sampled_before_learning:
+            losses, qs = [], []
+            for _ in range(cfg.num_train_batches_per_step):
+                raw = ray.get(self.replay.sample.remote(
+                    cfg.train_batch_size, cfg.prioritized_replay_beta))
+                if raw is None:
+                    break
+                batch = {k: jnp.asarray(v) for k, v in raw.items()
+                         if k != BATCH_INDEXES}
+                self.params, self._opt_state, loss, aux = self._update(
+                    self.params, self.target_params, self._opt_state,
+                    batch)
+                if cfg.prioritized_replay:
+                    self.replay.update_priorities.remote(
+                        raw[BATCH_INDEXES],
+                        np.asarray(aux["td_errors"]))
+                losses.append(float(loss))
+                qs.append(float(aux["mean_q"]))
+            if losses:
+                metrics["loss"] = float(np.mean(losses))
+                metrics["mean_q"] = float(np.mean(qs))
+            # 3. periodic hard target sync
+            if self._steps_since_target_sync >= \
+                    cfg.target_network_update_freq:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+                self._steps_since_target_sync = 0
+            self._sync_worker_weights()
+        returns = []
+        for w in self.workers:
+            try:
+                returns.extend(ray.get(w.episode_returns.remote()))
+            except Exception:
+                pass
+        if returns:
+            metrics["episode_reward_mean"] = float(np.mean(returns))
+            metrics["episodes_this_iter"] = len(returns)
+        return metrics
+
+    def save_checkpoint(self):
+        return {"params": jax.device_get(self.params),
+                "target_params": jax.device_get(self.target_params),
+                "opt_state": jax.device_get(self._opt_state),
+                "steps": self._steps_sampled}
+
+    def load_checkpoint(self, state):
+        self.params = jax.device_put(state["params"])
+        self.target_params = jax.device_put(state["target_params"])
+        self._opt_state = jax.device_put(state["opt_state"])
+        self._steps_sampled = state.get("steps", 0)
+        self._sync_worker_weights()
+
+    def cleanup(self):
+        for w in self.workers:
+            try:
+                ray.kill(w)
+            except Exception:
+                pass
+        try:
+            ray.kill(self.replay)
+        except Exception:
+            pass

@@ -43,73 +43,229 @@ class ReplicaWrapper:
 
 @ray.remote
 class ServeController:
-    """Reference: serve/controller.py:69 + _private/deployment_state.py:998
-    (DeploymentState reconciliation loop, here reconcile())."""
+    """Reference: serve/controller.py:69 + _private/deployment_state.py
+    (DeploymentStateManager.update, :1855) — a BACKGROUND reconciliation
+    loop continuously drives actual replica sets toward target state:
+    dead replicas are replaced with no deploy call, autoscaling targets
+    are recomputed from handle-reported queue depth
+    (_private/autoscaling_policy.py), and version changes roll replicas
+    one per tick (rolling update)."""
+
+    RECONCILE_PERIOD_S = 1.0
 
     def __init__(self):
         self._deployments: Dict[str, Dict[str, Any]] = {}
-        self._replicas: Dict[str, List[Any]] = {}
+        # name -> list of {"actor": handle, "version": int}
+        self._replicas: Dict[str, List[Dict[str, Any]]] = {}
+        # autoscaling inputs: (name, handle_id) -> (ongoing, monotonic ts)
+        self._handle_metrics: Dict[tuple, tuple] = {}
+        self._last_scale_up: Dict[str, float] = {}
+        # Retired replicas draining before the actual kill: handles stop
+        # routing to them immediately (they leave get_replicas), but the
+        # process lives past the handle-refresh TTL so in-flight requests
+        # finish (reference: graceful_shutdown_wait_loop_s drain).
+        self._draining: List[tuple] = []  # (actor, kill_at_monotonic)
+        self._lock = threading.RLock()
+        # Serializes whole reconcile ticks: the background loop thread and
+        # an actor-method reconcile (deploy/scale) must not both spawn.
+        self._reconcile_lock = threading.Lock()
+        self._stopped = False
+        threading.Thread(target=self._loop, daemon=True,
+                         name="serve-reconcile").start()
+
+    def _loop(self):
+        while not self._stopped:
+            time.sleep(self.RECONCILE_PERIOD_S)
+            try:
+                self.reconcile()
+            except Exception:
+                pass
 
     def deploy(self, name: str, payload: Dict[str, Any]):
-        """payload: cls_or_fn, init_args/kwargs, num_replicas, resources."""
-        self._deployments[name] = payload
+        """payload: cls_or_fn, init_args/kwargs, num_replicas, resources,
+        optional autoscaling_config.  A changed payload bumps the version;
+        reconcile then rolls replicas over to it."""
+        def _same(a, b):
+            # Compare by pickled bytes: cls_or_fn crosses the wire by
+            # value (cloudpickle), so two deploys of identical code
+            # deserialize to distinct class objects that == treats as
+            # different.  Byte equality is a sound idempotence check; a
+            # false negative merely costs a (safe) rolling restart.
+            from ray_tpu._private import serialization as _ser
+
+            keys = ("cls_or_fn", "init_args", "init_kwargs",
+                    "num_replicas", "num_cpus", "num_tpus",
+                    "autoscaling_config")
+            try:
+                return all(
+                    _ser.dumps_inline(a.get(k)) == _ser.dumps_inline(
+                        b.get(k)) for k in keys)
+            except Exception:
+                return False
+
+        with self._lock:
+            prev = self._deployments.get(name)
+            if prev is not None and _same(prev, payload):
+                return True  # idempotent redeploy: no rolling restart
+            version = (prev["version"] + 1) if prev is not None else 1
+            payload["version"] = version
+            self._deployments[name] = payload
+        # Reconcile outside _lock: the tick takes _reconcile_lock then
+        # _lock — holding _lock here would invert the order vs the
+        # background loop and deadlock.
         self.reconcile()
         return True
 
     def delete_deployment(self, name: str):
-        self._deployments.pop(name, None)
-        for r in self._replicas.pop(name, []):
-            try:
-                ray.kill(r)
-            except Exception:
-                pass
+        with self._lock:
+            self._deployments.pop(name, None)
+            for r in self._replicas.pop(name, []):
+                try:
+                    ray.kill(r["actor"])
+                except Exception:
+                    pass
         return True
 
-    def _spawn(self, name: str):
-        d = self._deployments[name]
+    def record_handle_metric(self, name: str, handle_id: str, ongoing: int):
+        """Handles report their in-flight request count — the autoscaling
+        signal (reference: handle-side metrics pushed to the controller,
+        _private/router.py + autoscaling_policy.py)."""
+        with self._lock:
+            self._handle_metrics[(name, handle_id)] = (
+                ongoing, time.monotonic())
+        return True
+
+    def _spawn(self, d: Dict[str, Any], version: int):
         opts = {"num_cpus": d.get("num_cpus", 1)}
         if d.get("num_tpus"):
             opts["num_tpus"] = d["num_tpus"]
         remote_cls = ray.remote(ReplicaWrapper)
-        return remote_cls.options(**opts).remote(
+        actor = remote_cls.options(**opts).remote(
             d["cls_or_fn"], d.get("init_args", ()),
             d.get("init_kwargs", {}))
+        return {"actor": actor, "version": version}
+
+    def _autoscale_target(self, name: str, d: Dict[str, Any]) -> int:
+        cfg = d.get("autoscaling_config")
+        if not cfg:
+            return d.get("num_replicas", 1)
+        now = time.monotonic()
+        with self._lock:
+            ongoing = sum(v for (n, _h), (v, ts)
+                          in self._handle_metrics.items()
+                          if n == name and now - ts < 10.0)
+        target_per = max(cfg.get("target_ongoing_requests", 1), 1e-9)
+        import math
+
+        desired = math.ceil(ongoing / target_per)
+        desired = max(cfg.get("min_replicas", 1),
+                      min(cfg.get("max_replicas", 1), desired))
+        cur = len(self._replicas.get(name, []))
+        if desired > cur:
+            self._last_scale_up[name] = now
+            return desired
+        if desired < cur:
+            # Downscale only after a quiet period (reference:
+            # downscale_delay_s in autoscaling_policy.py).
+            delay = cfg.get("downscale_delay_s", 5.0)
+            if now - self._last_scale_up.get(name, 0.0) < delay:
+                return cur
+        return desired
 
     def reconcile(self):
-        """Drive actual replica sets toward target counts; replace dead
-        replicas (controller-driven health checks,
-        _private/deployment_state.py)."""
-        for name, d in self._deployments.items():
-            reps = self._replicas.setdefault(name, [])
+        """One control-loop tick: health-check, replace dead, scale to
+        target (static or autoscaled), roll one outdated replica."""
+        with self._reconcile_lock:
+            return self._reconcile_once()
+
+    DRAIN_S = 3.0
+
+    def _retire(self, rep):
+        with self._lock:
+            self._draining.append(
+                (rep["actor"], time.monotonic() + self.DRAIN_S))
+
+    def _reap_draining(self):
+        now = time.monotonic()
+        with self._lock:
+            due = [a for a, t in self._draining if t <= now]
+            self._draining = [(a, t) for a, t in self._draining if t > now]
+        for a in due:
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
+
+    def _reconcile_once(self):
+        self._reap_draining()
+        with self._lock:
+            names = list(self._deployments)
+        counts = {}
+        for name in names:
+            with self._lock:
+                d = self._deployments.get(name)
+                if d is None:
+                    continue
+                reps = list(self._replicas.get(name, []))
+                version = d["version"]
             alive = []
             for r in reps:
                 try:
-                    ray.get(r.health_check.remote(), timeout=5)
+                    ray.get(r["actor"].health_check.remote(), timeout=5)
                     alive.append(r)
                 except Exception:
-                    pass
-            target = d.get("num_replicas", 1)
+                    pass  # dead or unhealthy: dropped, replaced below
+            target = self._autoscale_target(name, d)
             while len(alive) < target:
-                alive.append(self._spawn(name))
+                alive.append(self._spawn(d, version))
             while len(alive) > target:
-                doomed = alive.pop()
+                self._retire(alive.pop())
+            # Rolling update: one outdated replica per tick — spawn the
+            # replacement first, then retire (drain) the old one, so
+            # capacity never dips and in-flight requests finish
+            # (reference: rolling updates in deployment_state).
+            outdated = [r for r in alive if r["version"] != version]
+            if outdated:
+                alive.append(self._spawn(d, version))
+                old = outdated[0]
+                alive.remove(old)
+                self._retire(old)
+            with self._lock:
+                if name in self._deployments:
+                    self._replicas[name] = alive
+                    counts[name] = len(alive)
+                    continue
+            # Deleted mid-tick: nothing tracks these replicas anymore.
+            for r in alive:
                 try:
-                    ray.kill(doomed)
+                    ray.kill(r["actor"])
                 except Exception:
                     pass
-            self._replicas[name] = alive
-        return {n: len(r) for n, r in self._replicas.items()}
+        return counts
 
     def get_replicas(self, name: str):
-        return list(self._replicas.get(name, []))
+        with self._lock:
+            return [r["actor"] for r in self._replicas.get(name, [])]
+
+    def num_replicas(self, name: str) -> int:
+        with self._lock:
+            return len(self._replicas.get(name, []))
 
     def list_deployments(self):
-        return {n: {"num_replicas": d.get("num_replicas", 1)}
-                for n, d in self._deployments.items()}
+        with self._lock:
+            return {n: {"num_replicas": d.get("num_replicas", 1),
+                        "version": d.get("version", 1),
+                        "autoscaling": bool(d.get("autoscaling_config"))}
+                    for n, d in self._deployments.items()}
 
     def scale(self, name: str, num_replicas: int):
-        self._deployments[name]["num_replicas"] = num_replicas
+        with self._lock:
+            self._deployments[name]["num_replicas"] = num_replicas
         self.reconcile()
+        return True
+
+    def stop(self):
+        self._stopped = True
         return True
 
 
@@ -124,14 +280,24 @@ class DeploymentHandle:
     """
 
     _TTL = 2.0
+    _METRIC_PERIOD = 0.5
 
     def __init__(self, name: str, controller):
+        import os
+
         self._name = name
         self._controller = controller
         self._replicas: List[Any] = []
         self._fetched_at = 0.0
         self._rr = itertools.count()
         self._lock = threading.Lock()
+        # Autoscaling signal: outstanding request refs this handle issued;
+        # pruned on each call and reported to the controller (reference:
+        # handle-side num_queued/ongoing metrics feeding
+        # autoscaling_policy.py).
+        self._handle_id = os.urandom(4).hex()
+        self._outstanding: List[Any] = []
+        self._last_report = 0.0
         self._refresh()
 
     def _refresh(self):
@@ -148,8 +314,40 @@ class DeploymentHandle:
                     f"deployment {self._name} has no replicas")
             return self._replicas[next(self._rr) % len(self._replicas)]
 
+    def _track(self, ref):
+        import weakref
+
+        now = time.monotonic()
+        with self._lock:
+            # Weak refs: the handle must never pin result objects — an
+            # idle handle after a burst would otherwise hold the last
+            # batch's outputs alive in the object store forever.
+            self._outstanding.append(weakref.ref(ref))
+            if now - self._last_report < self._METRIC_PERIOD:
+                return ref
+            self._last_report = now
+            live = [w() for w in self._outstanding]
+            live = [r for r in live if r is not None]
+            if live:
+                import ray_tpu as _ray
+
+                done, pending = _ray.wait(
+                    live, num_returns=len(live), timeout=0)
+                pend_set = {r.id() for r in pending}
+                self._outstanding = [
+                    w for w in self._outstanding
+                    if (r := w()) is not None and r.id() in pend_set]
+                ongoing = len(pending)
+            else:
+                self._outstanding = []
+                ongoing = 0
+        # Fire-and-forget: the metric must never block the data path.
+        self._controller.record_handle_metric.remote(
+            self._name, self._handle_id, ongoing)
+        return ref
+
     def remote(self, *args, **kwargs):
-        return self._pick().handle_request.remote(args, kwargs)
+        return self._track(self._pick().handle_request.remote(args, kwargs))
 
     def method(self, method_name: str):
         handle = self
@@ -168,13 +366,17 @@ class Deployment:
 
     def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
                  num_cpus: float = 1, num_tpus: int = 0,
-                 route_prefix: Optional[str] = None):
+                 route_prefix: Optional[str] = None,
+                 autoscaling_config: Optional[Dict[str, Any]] = None):
         self._cls_or_fn = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
         self.num_cpus = num_cpus
         self.num_tpus = num_tpus
         self.route_prefix = route_prefix or f"/{name}"
+        # {min_replicas, max_replicas, target_ongoing_requests,
+        #  downscale_delay_s} (reference: serve AutoscalingConfig)
+        self.autoscaling_config = autoscaling_config
         self._init_args = ()
         self._init_kwargs = {}
 
@@ -183,7 +385,9 @@ class Deployment:
                        kw.get("num_replicas", self.num_replicas),
                        kw.get("num_cpus", self.num_cpus),
                        kw.get("num_tpus", self.num_tpus),
-                       kw.get("route_prefix", self.route_prefix))
+                       kw.get("route_prefix", self.route_prefix),
+                       kw.get("autoscaling_config",
+                              self.autoscaling_config))
         d._init_args = self._init_args
         d._init_kwargs = self._init_kwargs
         return d
@@ -197,12 +401,14 @@ class Deployment:
 
 def deployment(cls_or_fn=None, *, name: Optional[str] = None,
                num_replicas: int = 1, num_cpus: float = 1,
-               num_tpus: int = 0, route_prefix: Optional[str] = None):
+               num_tpus: int = 0, route_prefix: Optional[str] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None):
     """@serve.deployment (reference: serve/api.py deployment)."""
 
     def wrap(target):
         return Deployment(target, name or target.__name__, num_replicas,
-                          num_cpus, num_tpus, route_prefix)
+                          num_cpus, num_tpus, route_prefix,
+                          autoscaling_config)
 
     if cls_or_fn is not None:
         return wrap(cls_or_fn)
@@ -232,6 +438,7 @@ def run(target: Deployment, *, name: Optional[str] = None
         "num_replicas": target.num_replicas,
         "num_cpus": target.num_cpus,
         "num_tpus": target.num_tpus,
+        "autoscaling_config": target.autoscaling_config,
     }))
     handle = DeploymentHandle(dep_name, controller)
     _state["handles"][dep_name] = handle

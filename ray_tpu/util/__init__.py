@@ -9,10 +9,11 @@ from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
 )
 from ray_tpu.util.actor_pool import ActorPool
-from ray_tpu.util import accelerators
+from ray_tpu.util import accelerators, metrics, state
 
 __all__ = [
     "placement_group", "remove_placement_group", "placement_group_table",
     "PlacementGroup", "PlacementGroupSchedulingStrategy",
     "NodeAffinitySchedulingStrategy", "ActorPool", "accelerators",
+    "metrics", "state",
 ]

@@ -94,3 +94,98 @@ def test_stats_and_schema(ray8):
     assert ds.sum("x") == 45.0
     assert ds.mean("x") == 4.5
     assert ds.schema() == {"x": "float"}
+
+
+def test_lazy_plan_fuses_ops(ray8):
+    """Transforms build a plan (no tasks yet); execution fuses the chain
+    into one task per block (reference: operator fusion in the streaming
+    executor)."""
+    ds = rd.range(32, parallelism=4).map(lambda x: x + 1) \
+        .filter(lambda x: x % 2 == 0).map(lambda x: x * 10)
+    assert len(ds._ops) == 3          # still unexecuted
+    assert ds.num_blocks() == 4
+    assert sorted(ds.take_all()) == [x * 10 for x in range(2, 34, 2)]
+
+
+def test_streaming_window_bounds_inflight(ray8):
+    """The executor keeps at most DEFAULT_STREAMING_WINDOW block tasks in
+    flight: with 3x window blocks, consuming the first row must not have
+    executed every block (bulk execution would)."""
+    import ray_tpu.data.dataset as dsmod
+
+    marker_dir = "/tmp/rtpu_stream_markers_%d" % __import__("os").getpid()
+    import os
+    import shutil
+
+    shutil.rmtree(marker_dir, ignore_errors=True)
+    os.makedirs(marker_dir)
+    n_blocks = dsmod.DEFAULT_STREAMING_WINDOW * 3
+
+    def touch(x):
+        open(os.path.join(marker_dir, "%d_%d" % (x, os.getpid())), "w")
+        return x
+
+    ds = rd.range(n_blocks, parallelism=n_blocks).map(touch)
+    it = ds.iter_rows()
+    first = next(it)
+    assert first == 0
+    executed = len(os.listdir(marker_dir))
+    assert executed <= 2 * dsmod.DEFAULT_STREAMING_WINDOW, (
+        f"{executed} blocks executed after first row; window is "
+        f"{dsmod.DEFAULT_STREAMING_WINDOW}")
+    rest = list(it)
+    assert sorted([first] + rest) == list(range(n_blocks))
+    shutil.rmtree(marker_dir, ignore_errors=True)
+
+
+def test_repartition_no_driver_collect(ray8):
+    ds = rd.range(100, parallelism=7).repartition(4)
+    assert ds.num_blocks() == 4
+    counts = [ray.get(rd.dataset._count_block.remote(b))
+              for b in ds._blocks]
+    assert counts == [25, 25, 25, 25]
+    assert sorted(ds.take_all()) == list(range(100))
+
+
+def test_split_lazy_consumed_in_workers(ray8):
+    """split() shards are block refs + plan; Train-style workers iterate
+    them inside their own processes (no driver round trip for rows)."""
+    ds = rd.range(60, parallelism=6).map(lambda x: {"v": x})
+    shards = ds.split(3)
+
+    @ray.remote
+    def consume(shard):
+        total = 0
+        rows = 0
+        for batch in shard.iter_batches(batch_size=8):
+            total += int(batch["v"].sum())
+            rows += len(batch["v"])
+        return rows, total
+
+    got = ray.get([consume.remote(s) for s in shards], timeout=120)
+    assert sum(r for r, _ in got) == 60
+    assert sum(t for _, t in got) == sum(range(60))
+
+
+def test_limit_early_exit(ray8):
+    ds = rd.range(1000, parallelism=100)
+    out = ds.limit(25).take_all()
+    assert out == list(range(25))
+
+
+def test_arrow_blocks_roundtrip(ray8, tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    table = pa.Table.from_pylist([{"a": i, "b": i * 0.5} for i in range(40)])
+    ds = rd.from_arrow(table, parallelism=4)
+    assert ds.count() == 40
+    # map_batches in pyarrow format keeps Table blocks end-to-end
+    def double(t):
+        import pyarrow as pa
+        return t.set_column(0, "a", pa.array([x * 2 for x in
+                                              t.column("a").to_pylist()]))
+    ds2 = ds.map_batches(double, batch_format="pyarrow")
+    assert sorted(r["a"] for r in ds2.take_all()) == \
+        sorted(i * 2 for i in range(40))
+    ds2.write_parquet(str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 40
