@@ -10,9 +10,11 @@ TPU-era equivalent:
 - spawns worker processes when the head's scheduler leases one here
   (reference: ``worker_pool.cc``); workers dial the head directly, so the
   agent stays out of the task hot path;
-- serves ``read_segment`` requests: reads a local shm segment's serialized
-  parts so the head can ship objects across nodes (the condensed form of
-  ``ObjectManager::Push/Pull``, ``object_manager.h:117,206``).
+- runs an OBJECT SERVER on its own TCP listener: consumers on other nodes
+  (and the driver) pull segments directly as 1 MB chunk streams — the
+  head brokers locations only (``ObjectManager::Push/Pull``,
+  ``object_manager.h:117,206``; chunking per ``object_buffer_pool.h``);
+- still serves head-relayed ``read_segment`` as the fallback path.
 
 Run: ``python -m ray_tpu._private.node_agent`` with RAY_TPU_HEAD_ADDRESS /
 RAY_TPU_AUTHKEY / RAY_TPU_AGENT_* env vars (see cluster_utils.Cluster).
@@ -27,10 +29,10 @@ import subprocess
 import sys
 import threading
 import time
-from multiprocessing.connection import Client
+from multiprocessing.connection import Client, Listener
 from typing import Dict
 
-from ray_tpu._private import protocol
+from ray_tpu._private import object_transfer, protocol
 from ray_tpu._private.shm_store import ShmStore
 
 
@@ -45,11 +47,32 @@ class NodeAgent:
         self.store_id = os.urandom(8).hex()
         self.shm_dir = shm_dir
         os.makedirs(shm_dir, exist_ok=True)
+        # Attach-only store; re-created with the session id after the head
+        # acks registration (the object server may get connections first).
+        self.store = ShmStore(shm_dir=shm_dir)
         self.conn = None
         self.send_lock = threading.Lock()
         self.workers: Dict[str, subprocess.Popen] = {}
         self.session = ""
         self._stopped = False
+        # Object server: direct chunked pulls from this node's store
+        # (reference: the per-node object manager's transfer port).
+        host = os.environ.get("RAY_TPU_AGENT_LISTEN_HOST", "127.0.0.1")
+        self._obj_listener = Listener((host, 0), "AF_INET", backlog=64,
+                                      authkey=authkey)
+        # Advertise an address other hosts can reach: binding 0.0.0.0 (a
+        # real multi-host cluster) must not advertise the bind address.
+        adv = os.environ.get("RAY_TPU_AGENT_ADVERTISE_HOST")
+        if adv is None:
+            adv = host
+            if adv == "0.0.0.0":
+                import socket
+
+                adv = socket.gethostbyname(socket.gethostname())
+        port = self._obj_listener.address[1]
+        self.object_addr = protocol.format_address((adv, port))
+        threading.Thread(target=self._object_server, daemon=True,
+                         name="agent-objsrv").start()
 
     def _send(self, msg):
         with self.send_lock:
@@ -71,6 +94,7 @@ class NodeAgent:
             "labels": self.labels,
             "store_id": self.store_id,
             "shm_dir": self.shm_dir,
+            "object_addr": self.object_addr,
             "pid": os.getpid(),
             "hostname": os.uname().nodename,
         }))
@@ -81,6 +105,19 @@ class NodeAgent:
         # Attach-only store for read_segment (segments here are created by
         # this node's workers; the agent never allocates).
         self.store = ShmStore(shm_dir=self.shm_dir, session_id=self.session)
+
+    def _object_server(self):
+        while not self._stopped:
+            try:
+                conn = self._obj_listener.accept()
+            except Exception:
+                if self._stopped:
+                    return
+                continue
+            threading.Thread(
+                target=object_transfer.serve_connection,
+                args=(conn, self.store), daemon=True,
+                name="agent-objconn").start()
 
     def serve(self):
         while not self._stopped:
@@ -158,6 +195,10 @@ class NodeAgent:
                     pass
         try:
             self.conn.close()
+        except Exception:
+            pass
+        try:
+            self._obj_listener.close()
         except Exception:
             pass
 

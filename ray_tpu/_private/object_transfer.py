@@ -1,0 +1,147 @@
+"""Direct node-to-node object transfer, chunked.
+
+Reference: ``src/ray/object_manager/object_manager.h:117,206`` +
+``object_buffer_pool.h`` — objects move between nodes in bounded chunks
+directly between the object managers; the control plane (GCS) only brokers
+*locations*.  Here every node agent runs an object server on its own TCP
+listener; consumers (workers on other nodes, or the driver) dial it and
+pull the segment as a stream of ≤1 MB chunks.  The head carries location
+lookups only — never payload bytes.
+
+Flow control: one segment streams per connection at a time in CHUNK-sized
+sends; the receiver reads with ``recv_bytes_into`` straight into the
+destination buffer (one copy end-to-end), and TCP's window bounds the
+bytes in flight (the reference's in-flight chunk cap).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.shm_store import _HEADER, _MAGIC
+
+CHUNK = 1 << 20  # 1 MB, the reference's object-manager chunk size
+
+
+def serve_connection(conn, store):
+    """Agent-side loop for one consumer connection: stream requested
+    segments chunk by chunk (reference: ObjectManager::Push)."""
+    try:
+        while True:
+            msg = protocol.recv(conn)
+            if msg[0] == "fetch":
+                name = msg[1]
+                try:
+                    seg = store.attach(name)
+                except Exception as e:  # noqa: BLE001
+                    protocol.send(conn, ("err", repr(e)))
+                    continue
+                try:
+                    mv = memoryview(seg._mm)
+                    total = len(mv)
+                    protocol.send(conn, ("ok", total))
+                    for off in range(0, total, CHUNK):
+                        conn.send_bytes(mv[off:off + CHUNK])
+                finally:
+                    del mv
+                    seg.close()
+            elif msg[0] == "close":
+                return
+    except (EOFError, OSError, TypeError):
+        return
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+class ObjectPuller:
+    """Consumer-side client: cached connections to home-store object
+    servers, pulling segments as chunk streams (reference:
+    ObjectManager::Pull + ObjectBufferPool chunk assembly)."""
+
+    def __init__(self, authkey: bytes):
+        self._authkey = authkey
+        self._conns: Dict[str, tuple] = {}  # store_id -> (conn, lock)
+        self._lock = threading.Lock()
+
+    def _conn_for(self, store_id: str, addr: str):
+        with self._lock:
+            ent = self._conns.get(store_id)
+        if ent is not None:
+            return ent
+        from multiprocessing.connection import Client
+
+        conn = Client(protocol.parse_address(addr), authkey=self._authkey)
+        ent = (conn, threading.Lock())
+        with self._lock:
+            # A racing dialer may have won; keep one, close the other.
+            cur = self._conns.setdefault(store_id, ent)
+            if cur is not ent:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            return cur
+
+    def drop(self, store_id: str):
+        with self._lock:
+            ent = self._conns.pop(store_id, None)
+        if ent is not None:
+            try:
+                ent[0].close()
+            except Exception:
+                pass
+
+    def fetch(self, store_id: str, addr: str, name: str) -> bytearray:
+        """The raw segment bytes, pulled in CHUNK pieces."""
+        conn, lock = self._conn_for(store_id, addr)
+        try:
+            with lock:
+                protocol.send(conn, ("fetch", name))
+                tag, val = protocol.recv(conn)
+                if tag != "ok":
+                    from ray_tpu import exceptions as exc
+
+                    raise exc.ObjectLostError(
+                        f"segment {name} unreadable at {store_id}: {val}")
+                total = val
+                buf = bytearray(total)
+                view = memoryview(buf)
+                off = 0
+                while off < total:
+                    off += conn.recv_bytes_into(view, off)
+                return buf
+        except (EOFError, OSError, TypeError, struct.error):
+            self.drop(store_id)
+            raise
+
+    def close(self):
+        with self._lock:
+            conns, self._conns = list(self._conns.values()), {}
+        for conn, _ in conns:
+            try:
+                protocol.send(conn, ("close",))
+            except Exception:
+                pass
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+def parse_segment_bytes(buf) -> Tuple[bytes, List[memoryview]]:
+    """(payload_meta, buffer views) from raw segment bytes — the same
+    layout Segment.raw_parts reads from an mmap (shm_store.py)."""
+    view = memoryview(buf)
+    magic, meta_len = _HEADER.unpack_from(view, 0)
+    if magic != _MAGIC:
+        raise ValueError("corrupt segment stream")
+    table = bytes(view[_HEADER.size:_HEADER.size + meta_len])
+    offsets, lengths, payload = serialization.loads_inline(table)
+    buffers = [view[o:o + n] for o, n in zip(offsets, lengths)]
+    return payload, buffers

@@ -35,7 +35,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import object_transfer, protocol, serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (
     ActorID,
@@ -227,8 +227,9 @@ class AgentHandle:
             protocol.send(self.conn, msg)
 
     def request_segment(self, name: str, timeout: float = 30.0):
-        """Blocking read of a remote segment's serialized parts.  Must be
-        called WITHOUT the runtime lock held."""
+        """Blocking HEAD-RELAYED read of a remote segment's serialized
+        parts — the fallback when a direct object-server pull is not
+        possible.  Must be called WITHOUT the runtime lock held."""
         with self._pending_lock:
             self._rid += 1
             rid = self._rid
@@ -364,6 +365,12 @@ class Runtime:
         self._conn_to_agent: Dict[Any, AgentHandle] = {}
         self._agents: Dict[str, AgentHandle] = {}  # store_id -> handle
         self._pending_workers: Dict[str, WorkerHandle] = {}
+        # Direct chunked pulls from remote object servers (reference:
+        # ObjectManager::Pull); the head-relay path remains as fallback
+        # and counts its uses (tests assert it stays cold).
+        self._puller = object_transfer.ObjectPuller(b"")  # authkey set below
+        self.relayed_segments = 0   # head-relayed agent reads (fallback)
+        self.brokered_parts = 0     # worker getparts served via the head
         # Identity of this process's object store: SHM descriptors carry it
         # so consumers know whether a segment is locally attachable or must
         # be shipped (reference: owner-based object directory).
@@ -380,6 +387,7 @@ class Runtime:
         self._sock_dir = f"/tmp/ray_tpu_{self.session_id}"
         os.makedirs(self._sock_dir, exist_ok=True)
         self._authkey = os.urandom(16)
+        self._puller._authkey = self._authkey
         self._listener = multiprocessing.connection.Listener(
             os.path.join(self._sock_dir, "worker.sock"), "AF_UNIX",
             backlog=512, authkey=self._authkey)
@@ -918,6 +926,19 @@ class Runtime:
             raise exc.ObjectLostError(
                 f"object store {home} is gone (node died); segment "
                 f"{descr[1]} unrecoverable")
+        addr = agent.info.get("object_addr")
+        if addr:
+            # Direct chunked pull from the home node's object server —
+            # the head never touches the payload (object_manager.h:206).
+            try:
+                buf = self._puller.fetch(home, addr, descr[1])
+                return object_transfer.parse_segment_bytes(buf)
+            except exc.ObjectLostError:
+                raise
+            except Exception:
+                pass  # conn trouble: fall back to the head relay
+        with self.lock:
+            self.relayed_segments += 1
         return agent.request_segment(descr[1])
 
     def get_objects(self, refs, timeout=None):
@@ -1845,11 +1866,16 @@ class Runtime:
                     msg[2])
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
+        elif tag == "result_batch":
+            for tid_bin, ok, returns, meta in msg[1]:
+                self._on_result(worker, tid_bin, ok, returns, meta)
         elif tag == "getparts":
             # Worker holds a descriptor for a segment in another node's
             # store: ship the serialized parts.  Fetch may block on a
             # remote agent, so it runs off the IO thread.
             rid, descr = msg[1], msg[2]
+            with self.lock:
+                self.brokered_parts += 1
 
             def fetch_and_reply(worker=worker, rid=rid, descr=descr):
                 try:
@@ -1882,6 +1908,10 @@ class Runtime:
                             worker.send(("obj", rid, True, descr2))
                             return
                         meta, bufs = self._fetch_parts(descr2)
+                    # Direct pulls hand back memoryviews (zero-copy for
+                    # driver-local use); pickling the reply needs bytes.
+                    bufs = [b if isinstance(b, bytes) else bytes(b)
+                            for b in bufs]
                     worker.send(("obj", rid, True,
                                  (protocol.PARTS, meta, bufs)))
                 except BaseException as e:  # noqa: BLE001
@@ -1955,6 +1985,15 @@ class Runtime:
                 worker.send(("reply", rid, actor_id))
             except Exception as e:  # noqa: BLE001
                 worker.send(("reply", rid, e))
+        elif tag == "store_addr":
+            # Location brokering only (reference: the owner-based object
+            # directory answering WHERE, never carrying bytes).
+            _, rid, store_hex = msg
+            with self.lock:
+                agent = self._agents.get(store_hex)
+                addr = (agent.info.get("object_addr")
+                        if agent is not None and not agent.dead else None)
+            worker.send(("reply", rid, addr))
         elif tag == "state_req":
             _, rid, kind, kwargs = msg
             try:

@@ -31,7 +31,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import object_transfer, protocol, serialization
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, new_task_id
 from ray_tpu._private import object_ref as object_ref_mod
 from ray_tpu._private.object_ref import ObjectRef
@@ -79,6 +79,22 @@ class _WorkerRuntime:
 
         self._local_cache: "OrderedDict[ObjectID, Any]" = OrderedDict()
         self._segments = _deque(maxlen=self._CACHE_CAP)
+        # Direct chunked pulls from remote object servers; the driver
+        # brokers locations only (reference: ObjectManager::Pull through
+        # the owner's directory, object_manager.h:206).
+        self._puller = object_transfer.ObjectPuller(
+            bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")))
+        self._store_addrs: Dict[str, Any] = {}  # store_id -> addr|None
+        # Completed-task results buffered between queue drains: back-to-
+        # back short tasks ride to the driver as ONE result_batch message
+        # (reference: batched reply streams; kills per-task head wakeups).
+        self._result_buf: list = []
+        self._result_lock = threading.Lock()
+        # Set by worker_entry: True when no tasks are queued.  Results
+        # buffer only while more work is queued behind them; a threaded
+        # actor's lone reply must go out immediately, not on the 0.25s
+        # timer.
+        self.queue_empty = lambda: True
 
     @property
     def current_task_id(self) -> Optional[TaskID]:
@@ -110,6 +126,26 @@ class _WorkerRuntime:
             if buf:
                 protocol.send(self.conn, ("decref_batch", buf))
             protocol.send(self.conn, msg)
+
+    def send_result(self, entry):
+        """Buffer one completed task's (task_id, ok, returns, meta);
+        batches only form while more tasks are queued behind this one."""
+        with self._result_lock:
+            self._result_buf.append(entry)
+            n = len(self._result_buf)
+        if n >= 16 or self.queue_empty():
+            self.flush_results()
+
+    def flush_results(self):
+        with self._result_lock:
+            if not self._result_buf:
+                return
+            buf, self._result_buf = self._result_buf, []
+        if len(buf) == 1:
+            e = buf[0]
+            self._send(("result", e[0], e[1], e[2], e[3]))
+        else:
+            self._send(("result_batch", buf))
 
     def flush_decrefs(self):
         with self._decref_lock:
@@ -145,9 +181,14 @@ class _WorkerRuntime:
             return serialization.loads(descr[1], descr[2])
         if kind in (protocol.SHM, protocol.SPILLED):
             if len(descr) > 3 and descr[3] != self.store_id:
-                # Segment homed in another node's store: ask the driver to
-                # ship its serialized parts (reference: ObjectManager pull
-                # through the owner, object_manager.h:206).
+                # Segment homed in another node's store: pull it directly
+                # from that node's object server in 1 MB chunks; the head
+                # relays only if the home store has no server (in-process
+                # test nodes) or the pull fails.
+                if kind == protocol.SHM:
+                    value = self._direct_pull(descr)
+                    if value is not _PULL_MISS:
+                        return value
                 ok, reply = self._request(
                     lambda rid: ("getparts", rid, tuple(descr)))
                 if not ok:
@@ -172,6 +213,23 @@ class _WorkerRuntime:
         if kind == protocol.ERROR:
             raise serialization.loads_inline(descr[1])
         raise ValueError(f"bad descriptor {descr!r}")
+
+    def _direct_pull(self, descr):
+        store = descr[3]
+        if store not in self._store_addrs:
+            self._store_addrs[store] = self._request(
+                lambda rid: ("store_addr", rid, store))
+        addr = self._store_addrs[store]
+        if not addr:
+            return _PULL_MISS
+        try:
+            buf = self._puller.fetch(store, addr, descr[1])
+            meta, bufs = object_transfer.parse_segment_bytes(buf)
+            return serialization.loads(meta, bufs)
+        except Exception:
+            # Agent gone or segment moved: the owner knows the truth —
+            # fall back to the brokered path (which also drives recovery).
+            return _PULL_MISS
 
     def serialize_value(self, value: Any, object_id: ObjectID):
         """Value -> descriptor, choosing inline vs shm by size (one
@@ -306,6 +364,8 @@ class _WorkerRuntime:
         return True
 
 
+_PULL_MISS = object()
+
 _runtime: Optional[_WorkerRuntime] = None
 
 
@@ -352,12 +412,12 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
             if asyncio.iscoroutine(result):
                 result = _run_coroutine(result)
         returns = _pack_returns(rt, task_id, result, num_returns)
-        rt._send(("result", task["task_id"], True, returns, {}))
+        rt.send_result((task["task_id"], True, returns, {}))
     except Exception as e:  # noqa: BLE001 — task errors become objects
         err = exc.TaskError.from_exception(name, e)
         payload = _pickle_error(err)
         returns = [(protocol.ERROR, payload)] * max(1, num_returns)
-        rt._send(("result", task["task_id"], False, returns, {}))
+        rt.send_result((task["task_id"], False, returns, {}))
     finally:
         rt.current_task_id = None
         rt.current_actor_id = None
@@ -535,6 +595,12 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                 except Exception:
                     pass
 
+    def _queue_empty():
+        with tq_cv:
+            return not tasks
+
+    rt.queue_empty = _queue_empty
+
     threading.Thread(target=reader, daemon=True, name="ray_tpu-reader").start()
 
     def decref_flusher():
@@ -544,6 +610,9 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
             _time.sleep(0.25)
             try:
                 rt.flush_decrefs()
+                # Bounds result-batch latency when a long task follows
+                # buffered short-task results.
+                rt.flush_results()
             except Exception:
                 return  # conn gone; reader exits the process
 
@@ -553,6 +622,10 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
 
     while True:
         with tq_cv:
+            if not tasks:
+                # Queue drained: everything buffered goes out as one batch
+                # before this worker parks.
+                rt.flush_results()
             while not tasks:
                 tq_cv.wait()
             msg = tasks.popleft()

@@ -39,7 +39,14 @@ class StandardAutoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.update_interval_s = update_interval_s
         self._idle_since: Dict[str, float] = {}
+        # Launches issued but whose node has not registered alive yet:
+        # counted against caps and capacity so an async provider cannot
+        # be asked twice for the same demand (reference: the pending-
+        # launch accounting in StandardAutoscaler).
+        self._pending_launches: Dict[str, tuple] = {}  # id -> (type, ts)
+        self._launch_timeout_s = 120.0
         self._stopped = False
+        self._gen = 0
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- policy
@@ -52,6 +59,9 @@ class StandardAutoscaler:
             return []
         free = [dict(n["resources"]) for n in self._rt.node_activity()
                 if n["alive"]]
+        # Nodes still booting count as capacity-to-be.
+        for _nid, (ntype, _ts) in self._pending_launches.items():
+            free.append(dict(self.provider.node_resources(ntype)))
         unfulfilled = []
         for shape in sorted(demand, key=lambda s: -sum(s.values())):
             for avail in free:
@@ -69,6 +79,8 @@ class StandardAutoscaler:
         pools: List[Dict[str, float]] = []
         counts = {t: len([n for n in self.provider.non_terminated_nodes()
                           if self.provider.node_type_of(n) == t])
+                  + len([1 for _ntype, _ in self._pending_launches.values()
+                         if _ntype == t])
                   for t in self.provider.node_types}
         for shape in unfulfilled:
             placed = False
@@ -96,11 +108,21 @@ class StandardAutoscaler:
     def update(self) -> Dict[str, Any]:
         """One reconcile tick: launch for unfulfilled demand, terminate
         slices idle past the timeout.  Returns what it did."""
+        # Reconcile pending launches first: registered or timed out.
+        now0 = time.monotonic()
+        alive_ids = {a["node_id"] for a in self._rt.node_activity()
+                     if a["alive"]}
+        for nid in list(self._pending_launches):
+            ntype, ts = self._pending_launches[nid]
+            if nid in alive_ids or now0 - ts > self._launch_timeout_s:
+                self._pending_launches.pop(nid, None)
         launched: List[str] = []
         for node_type, n in self._plan_launches(
                 self._unfulfilled_demand()).items():
             for _ in range(n):
-                launched.append(self.provider.create_node(node_type))
+                nid = self.provider.create_node(node_type)
+                launched.append(nid)
+                self._pending_launches[nid] = (node_type, now0)
         # scale-down: whole idle provider nodes only (never the head)
         now = time.monotonic()
         terminated: List[str] = []
@@ -133,10 +155,16 @@ class StandardAutoscaler:
         if self._thread is not None:
             return
         self._stopped = False
+        self._gen += 1
+        gen = self._gen
 
         def loop():
-            while not self._stopped:
+            # Generation check: a stop()+start() inside one sleep interval
+            # must not leave the superseded loop running alongside.
+            while not self._stopped and self._gen == gen:
                 time.sleep(self.update_interval_s)
+                if self._stopped or self._gen != gen:
+                    return
                 try:
                     self.update()
                 except Exception:
@@ -148,4 +176,5 @@ class StandardAutoscaler:
 
     def stop(self):
         self._stopped = True
+        self._gen += 1
         self._thread = None

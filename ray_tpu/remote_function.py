@@ -104,6 +104,7 @@ class RemoteFunction:
         self._options = dict(options or {})
         self._payload: Optional[bytes] = None
         self._func_id: Optional[str] = None
+        self._registered_with: Optional[str] = None
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
 
@@ -129,10 +130,14 @@ class RemoteFunction:
             if self._func_id is None:
                 self._func_id = hashlib.sha1(self._payload).hexdigest()[:24]
             return self._func_id, self._payload
-        if self._func_id is None:
+        # Register once per runtime SESSION (re-registering after
+        # shutdown/init matters; re-hashing on every .remote() does not).
+        # Keyed by session_id, not id(rt): a new Runtime can reuse the
+        # freed old one's memory address.
+        session = getattr(rt, "session_id", None)
+        if self._func_id is None or self._registered_with != session:
             self._func_id = rt.register_function(self._payload)
-        else:
-            rt.register_function(self._payload)
+            self._registered_with = session
         return self._func_id, None
 
     def remote(self, *args, **kwargs):

@@ -81,15 +81,24 @@ def test_actor_death_and_restart(ray_start_regular):
     f = Flaky.remote()
     with pytest.raises(ray.exceptions.RayTpuError):
         ray.get(f.crash.remote(), timeout=30)
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 60  # generous: 1-cpu CI boxes crawl
+    last = None
     while time.monotonic() < deadline:
         try:
             assert ray.get(f.ping.remote(), timeout=10) == "pong"
             break
-        except ray.exceptions.RayTpuError:
+        except ray.exceptions.RayTpuError as e:
+            last = e
             time.sleep(0.2)
     else:
-        pytest.fail("actor did not restart")
+        from ray_tpu._private import api_internal
+
+        rt = api_internal.get_runtime()
+        actor = next(iter(rt.actors.values()), None)
+        pytest.fail(
+            f"actor did not restart: last={type(last).__name__}({last}); "
+            f"actor_status={actor and actor.status} "
+            f"restarts_left={actor and actor.restarts_left}")
 
 
 def test_actor_no_restart_stays_dead(ray_start_regular):
