@@ -19,12 +19,37 @@ from ray_tpu import exceptions as exc
 def init(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
          resources: Optional[dict] = None, namespace: str = "default",
          ignore_reinit_error: bool = False, _system_config: dict | None = None,
-         **_compat_kwargs) -> Runtime:
-    """Start the runtime (reference: python/ray/_private/worker.py:1045).
+         address: Optional[str] = None, _authkey: Optional[str] = None,
+         **_compat_kwargs):
+    """Start the runtime (reference: python/ray/_private/worker.py:1045),
+    or — with ``address`` — ATTACH to a running cluster in client mode
+    (reference: Ray Client, ray.init("ray://...")).
 
     ``num_tpus`` defaults to the number of locally attached TPU chips if jax
     is importable and sees TPU devices; pass 0 to disable.
     """
+    import os as _os
+
+    if address is None:
+        address = _os.environ.get("RAY_TPU_CLIENT_ADDRESS")
+    if address:
+        cur = api_internal.get_runtime()
+        if cur is not None and getattr(cur, "is_client", False):
+            # Honor the reinit contract in client mode too: never stack a
+            # second connection under existing ObjectRefs.
+            if ignore_reinit_error:
+                return cur
+            raise RuntimeError("ray_tpu.init() called twice "
+                               "(pass ignore_reinit_error=True to allow).")
+        from ray_tpu._private.client import client_connect
+
+        key = _authkey or _os.environ.get("RAY_TPU_CLIENT_AUTHKEY")
+        if not key:
+            raise ValueError("client mode needs _authkey= or "
+                             "RAY_TPU_CLIENT_AUTHKEY")
+        rt = client_connect(address, bytes.fromhex(key))
+        api_internal.set_global_runtime(rt)
+        return rt
     rt = api_internal.get_runtime()
     if rt is not None:
         if isinstance(rt, Runtime) and not rt._stopped:
@@ -64,6 +89,8 @@ def shutdown():
     rt = api_internal.get_runtime()
     if isinstance(rt, Runtime):
         rt.shutdown()
+    elif rt is not None and getattr(rt, "is_client", False):
+        rt.disconnect()
     api_internal.set_global_runtime(None)
 
 

@@ -1,0 +1,138 @@
+"""Client mode: attach an external process to a running cluster.
+
+Reference: ``python/ray/util/client/`` (Ray Client — a gRPC proxy that
+lets a process outside the cluster drive tasks/actors/objects;
+ARCHITECTURE.md).  Re-designed for this runtime's symmetric worker
+protocol: a client IS a worker connection that never takes a lease — it
+dials the head's TCP listener, handshakes ``client_ready``, and then the
+existing submit/mget/put/actor messages just work.  Large values ship as
+parts and land in the HEAD's store (clients cannot assume a shared
+/dev/shm), and large results stream back via the direct object-transfer
+pull or the head relay.
+
+Usage::
+
+    import ray_tpu as ray
+    ray.init(address="tcp://head:port", _authkey="<hex>")
+    # or env: RAY_TPU_CLIENT_ADDRESS / RAY_TPU_CLIENT_AUTHKEY
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Optional
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private import object_ref as object_ref_mod
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.shm_store import ShmStore
+from ray_tpu._private.worker_main import _WorkerRuntime
+
+
+class ClientRuntime(_WorkerRuntime):
+    """Worker runtime minus execution: submits, gets, puts, actors."""
+
+    is_client = True
+
+    def put_object(self, value) -> ObjectRef:
+        oid = ObjectID.for_put()
+        self.begin_ref_collection()
+        try:
+            res = serialization.dumps_adaptive(value, self.max_inline)
+        finally:
+            nested = self.end_ref_collection()
+        if res[0] == "inline":
+            self._send(("put", oid.binary(),
+                        (protocol.INLINE, res[1]), nested))
+        else:
+            # Ship parts: the head writes them into ITS store so cluster
+            # workers can consume them (clients share no /dev/shm).
+            self._send(("put_parts", oid.binary(), res[1],
+                        [bytes(b) for b in res[2]], nested))
+        self._cache_put(oid, value)
+        return ObjectRef(oid)
+
+    def serialize_value(self, value, object_id: ObjectID):
+        """By-value task args travel inline or as parts inside the spec —
+        never via a client-local shm segment nobody else can map."""
+        res = serialization.dumps_adaptive(value, self.max_inline)
+        if res[0] == "inline":
+            return (protocol.INLINE, res[1])
+        return (protocol.PARTS, res[1], [bytes(b) for b in res[2]])
+
+    def request(self, builder):
+        """Generic control request (cluster_info, jobs, state...)."""
+        return self._request(builder)
+
+    def disconnect(self):
+        try:
+            self.flush_decrefs()
+        except Exception:
+            pass
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+def client_connect(address: str, authkey: bytes,
+                   max_inline: int = 1024 * 1024) -> ClientRuntime:
+    import time
+    from multiprocessing.connection import Client as _Dial
+
+    addr = protocol.parse_address(address)
+    conn = None
+    err: Optional[BaseException] = None
+    for attempt in range(20):
+        try:
+            conn = _Dial(addr, authkey=authkey)
+            break
+        except (ConnectionError, OSError) as e:
+            err = e
+            time.sleep(0.1 * (attempt + 1))
+    if conn is None:
+        raise ConnectionError(f"cannot reach cluster at {address}: {err}")
+    os.environ.setdefault("RAY_TPU_AUTHKEY", authkey.hex())
+    shm = ShmStore(shm_dir=tempfile.mkdtemp(prefix="ray_tpu_client_"))
+    rt = ClientRuntime(conn, threading.Lock(), shm, max_inline)
+    protocol.send(conn, ("client_ready", os.urandom(16).hex()))
+    msg = protocol.recv(conn)
+    assert msg[0] == "client_ack", msg
+    rt.store_id = f"client-{os.urandom(4).hex()}"  # nothing shares it
+
+    def reader():
+        while True:
+            try:
+                m = protocol.recv(conn)
+            except (EOFError, OSError, TypeError):
+                return
+            tag = m[0]
+            if tag == "obj":
+                rt.deliver_reply(m[1], (m[2], m[3]))
+            elif tag == "mgot":
+                rt.deliver_reply(m[1], m[2])
+            elif tag == "waited":
+                rt.deliver_reply(m[1], m[2])
+            elif tag == "reply":
+                rt.deliver_reply(m[1], m[2])
+
+    threading.Thread(target=reader, daemon=True,
+                     name="ray_tpu-client-reader").start()
+
+    def flusher():
+        import time as _t
+
+        while True:
+            _t.sleep(0.25)
+            try:
+                rt.flush_decrefs()
+            except Exception:
+                return
+
+    threading.Thread(target=flusher, daemon=True,
+                     name="ray_tpu-client-flush").start()
+    object_ref_mod._set_runtime_accessor(lambda: rt)
+    return rt

@@ -26,6 +26,22 @@ from ray_tpu._private.shm_store import _HEADER, _MAGIC
 CHUNK = 1 << 20  # 1 MB, the reference's object-manager chunk size
 
 
+def _true_extent(view: memoryview) -> int:
+    """Bytes actually used by the segment — pooled reuse can leave a file
+    up to ~2x the object (plus stale freed-object bytes); shipping the
+    slack would waste network and receiver memory."""
+    try:
+        _magic, meta_len = _HEADER.unpack_from(view, 0)
+        table = bytes(view[_HEADER.size:_HEADER.size + meta_len])
+        offsets, lengths, _payload = serialization.loads_inline(table)
+        end = _HEADER.size + meta_len
+        for o, n in zip(offsets, lengths):
+            end = max(end, o + n)
+        return min(end, len(view))
+    except Exception:
+        return len(view)
+
+
 def serve_connection(conn, store):
     """Agent-side loop for one consumer connection: stream requested
     segments chunk by chunk (reference: ObjectManager::Push)."""
@@ -41,10 +57,10 @@ def serve_connection(conn, store):
                     continue
                 try:
                     mv = memoryview(seg._mm)
-                    total = len(mv)
+                    total = _true_extent(mv)
                     protocol.send(conn, ("ok", total))
                     for off in range(0, total, CHUNK):
-                        conn.send_bytes(mv[off:off + CHUNK])
+                        conn.send_bytes(mv[off:min(off + CHUNK, total)])
                 finally:
                     del mv
                     seg.close()

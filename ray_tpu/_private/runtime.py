@@ -600,6 +600,23 @@ class Runtime:
                 return (protocol.SPILLED, path, size, self.store_id)
         return (protocol.SHM, name, size, self.store_id)
 
+    def _store_parts_locally(self, oid: ObjectID, meta: bytes, bufs):
+        """Pre-serialized parts into the driver store (client puts),
+        with the same spill fallback as serialize_value."""
+        views = [memoryview(b) for b in bufs]
+        try:
+            name, size = self.shm.create_from_parts(oid, meta, views)
+        except MemoryError:
+            need = sum(len(b) for b in bufs) + len(meta) + 65536
+            self._spill_objects(need)
+            try:
+                name, size = self.shm.create_from_parts(oid, meta, views)
+            except MemoryError:
+                path, size = self.shm.create_spilled(
+                    oid, meta, views, self.spill_dir)
+                return (protocol.SPILLED, path, size, self.store_id)
+        return (protocol.SHM, name, size, self.store_id)
+
     def _spill_objects(self, need_bytes: int) -> int:
         """Move LRU-ish unpinned READY resident objects to spill_dir until
         ``need_bytes`` of shm is freed (or no victims remain).  Insertion
@@ -1293,9 +1310,56 @@ class Runtime:
     def _env_key_for(self, rec: TaskRecord, tpu_chips) -> str:
         env = rec.spec.get("runtime_env") or {}
         key = repr(sorted(env.get("env_vars", {}).items()))
+        if env.get("working_dir"):
+            # Content hash, not path: edited directories must not reuse
+            # idle workers that extracted the previous package.
+            key += f"|wd={self._package_working_dir(env['working_dir'])}"
         if tpu_chips:
             key += f"|tpu={','.join(map(str, tpu_chips))}"
         return key
+
+    def _package_working_dir(self, path: str) -> str:
+        """Zip a working_dir once and cache by content hash (reference:
+        runtime_env packaging.py — zip -> GCS KV -> workers download).
+        Workers fetch it over their connection via get_package."""
+        import hashlib
+        import io
+        import zipfile
+
+        path = os.path.abspath(path)
+        with self.lock:
+            cache = getattr(self, "_pkg_cache", None)
+            if cache is None:
+                cache = self._pkg_cache = {}      # pkg_id -> zip bytes
+                self._pkg_by_path = {}            # path -> (stamp, pkg_id)
+            ent = self._pkg_by_path.get(path)
+        # Validity stamp covers mtimes AND the file-name set, so deleted
+        # files invalidate the cache too.
+        names = sorted(os.path.relpath(os.path.join(r, f), path)
+                       for r, _d, fs in os.walk(path) for f in fs)
+        mtime = max((os.path.getmtime(os.path.join(path, n))
+                     for n in names), default=os.path.getmtime(path))
+        stamp = (mtime, hashlib.sha1(
+            "\0".join(names).encode()).hexdigest())
+        if ent is not None and ent[0] == stamp:
+            return ent[1]
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for n in names:
+                z.write(os.path.join(path, n), n)
+        blob = buf.getvalue()
+        pkg_id = hashlib.sha1(blob).hexdigest()[:16]
+        with self.lock:
+            if ent is not None and ent[1] != pkg_id:
+                # Superseded version: drop its zip unless another path
+                # still maps to it (head memory must not grow per edit).
+                old = ent[1]
+                if not any(v[1] == old for k, v in
+                           self._pkg_by_path.items() if k != path):
+                    self._pkg_cache.pop(old, None)
+            self._pkg_cache[pkg_id] = blob
+            self._pkg_by_path[path] = (stamp, pkg_id)
+        return pkg_id
 
     def _lease_worker_locked(self, node: NodeState, rec: TaskRecord,
                              tpu_chips) -> WorkerHandle:
@@ -1317,8 +1381,11 @@ class Runtime:
                                                 tpu_chips, worker_id)
         env = dict(os.environ)
         if rec is not None:
-            env.update(
-                (rec.spec.get("runtime_env") or {}).get("env_vars", {}))
+            renv = rec.spec.get("runtime_env") or {}
+            env.update(renv.get("env_vars", {}))
+            if renv.get("working_dir"):
+                env["RAY_TPU_WORKING_DIR_PKG"] = \
+                    self._package_working_dir(renv["working_dir"])
         if tpu_chips:
             env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
             env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(tpu_chips)}"
@@ -1371,8 +1438,11 @@ class Runtime:
         raylet WorkerPool::StartWorkerProcess, worker_pool.h:156)."""
         overrides = {}
         if rec is not None:
-            overrides.update(
-                (rec.spec.get("runtime_env") or {}).get("env_vars", {}))
+            renv = rec.spec.get("runtime_env") or {}
+            overrides.update(renv.get("env_vars", {}))
+            if renv.get("working_dir"):
+                overrides["RAY_TPU_WORKING_DIR_PKG"] = \
+                    self._package_working_dir(renv["working_dir"])
         if tpu_chips:
             overrides["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
             overrides["TPU_CHIPS_PER_PROCESS_BOUNDS"] = \
@@ -1409,6 +1479,21 @@ class Runtime:
                 continue
             if msg[0] == "agent_ready":
                 self._register_agent(conn, msg[1])
+                continue
+            if msg[0] == "client_ready":
+                # External process attaching in client mode (reference:
+                # Ray Client, python/ray/util/client/) — a worker-protocol
+                # connection that never takes a lease.
+                w = WorkerHandle(WorkerID.from_random(), None, None,
+                                 self.head_node, "client", [])
+                w.attach(conn)
+                w.ready.set()
+                with self.lock:
+                    self._conn_to_worker[conn] = w
+                protocol.send(conn, ("client_ack", self.session_id))
+                threading.Thread(target=self._worker_reader,
+                                 args=(conn, w), daemon=True,
+                                 name="ray_tpu-rx-client").start()
                 continue
             if msg[0] != "ready":
                 conn.close()
@@ -2014,6 +2099,54 @@ class Runtime:
                               actor.options.get("method_names", {}))))
             except ValueError:
                 worker.send(("reply", rid, (False, None, None)))
+        elif tag == "put_parts":
+            # Client-shipped value: land it in the HEAD's store so any
+            # worker can consume it (clients share no /dev/shm).
+            _, oid_bin, meta, bufs, nested = msg
+            oid = ObjectID(oid_bin)
+            try:
+                descr = self._store_parts_locally(oid, meta, bufs)
+            except Exception as e:  # noqa: BLE001
+                descr = (protocol.ERROR, serialization.dumps_inline(
+                    exc.RayTpuError(f"client put failed: {e!r}")))
+            with self.lock:
+                st = self.objects.get(oid)
+                if st is None:
+                    st = self.objects[oid] = ObjectState()
+                st.status = (READY if descr[0] != protocol.ERROR
+                             else ERRORED)
+                st.descr = descr
+                st.nested_ids = list(nested)
+                self._pin_nested_locked(st.nested_ids)
+        elif tag in ("job_submit", "job_status", "job_logs", "job_stop",
+                     "job_list"):
+            from ray_tpu.job_submission import _get_manager
+
+            mgr = _get_manager(self)
+            try:
+                if tag == "job_submit":
+                    out = mgr.submit(msg[2], msg[3], msg[4])
+                elif tag == "job_status":
+                    out = mgr.status(msg[2])
+                elif tag == "job_logs":
+                    out = mgr.logs(msg[2])
+                elif tag == "job_stop":
+                    out = mgr.stop(msg[2])
+                else:
+                    out = mgr.list()
+            except Exception as e:  # noqa: BLE001
+                out = e
+            worker.send(("reply", msg[1], out))
+        elif tag == "get_package":
+            blob = getattr(self, "_pkg_cache", {}).get(msg[2])
+            worker.send(("reply", msg[1], blob))
+        elif tag == "cluster_info":
+            worker.send(("reply", msg[1], {
+                "resources": self.cluster_resources(),
+                "available": self.available_resources(),
+                "nodes": self.list_nodes(),
+                "session_id": self.session_id,
+            }))
         elif tag == "put":
             _, oid_bin, descr, nested = msg
             oid = ObjectID(oid_bin)

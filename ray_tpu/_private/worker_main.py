@@ -514,6 +514,32 @@ def main():
     )
 
 
+def _setup_working_dir(rt: "_WorkerRuntime", pkg_id: str):
+    """Fetch + extract the job's working_dir package, then chdir into it
+    (reference: runtime_env working_dir — agent-materialized per worker;
+    here the package ships over the worker's own connection)."""
+    import io
+    import sys as _sys
+    import zipfile
+
+    dest = f"/tmp/ray_tpu_pkg_{pkg_id}"
+    if not os.path.isdir(dest):
+        blob = rt._request(lambda rid: ("get_package", rid, pkg_id))
+        if blob is None:
+            return
+        tmp = dest + f".tmp{os.getpid()}"
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            z.extractall(tmp)
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    os.chdir(dest)
+    _sys.path.insert(0, dest)
+
+
 def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                  max_inline: int, env: Dict[str, str], node_id_hex: str,
                  job_id_hex: str):
@@ -619,6 +645,13 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
     threading.Thread(target=decref_flusher, daemon=True,
                      name="ray_tpu-decref").start()
     protocol.send(conn, ("ready", worker_id_hex, os.getpid()))
+
+    # After the handshake (the accept loop requires "ready" first): fetch
+    # and enter the working_dir package before any task executes — exec
+    # messages just queue behind this.
+    pkg_id = os.environ.get("RAY_TPU_WORKING_DIR_PKG")
+    if pkg_id:
+        _setup_working_dir(rt, pkg_id)
 
     while True:
         with tq_cv:

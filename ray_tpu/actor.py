@@ -147,6 +147,13 @@ class ActorClass:
         clone._func_id = self._func_id
         return clone
 
+    def bind(self, *args, **kwargs):
+        """Lazy actor-construction DAG node (reference: python/ray/dag
+        ClassNode); method .bind on the result adds ClassMethodNodes."""
+        from ray_tpu.dag.node import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> ActorHandle:
         rt = require_runtime()
         opts = self._options

@@ -1,0 +1,111 @@
+"""Dashboard: HTTP observability over the runtime's state tables.
+
+Reference: ``dashboard/`` (aiohttp REST head with per-module routes —
+nodes/actors/jobs/state/metrics — backing the React UI).  Condensed to
+the REST surface (the part tools consume): JSON endpoints over the state
+API, user metrics, job manager, and a minimal HTML index for humans.
+
+    GET /api/nodes | /api/actors | /api/tasks | /api/objects
+        /api/workers | /api/placement_groups
+    GET /api/summary          task-name x state counts
+    GET /api/metrics          user Counter/Gauge/Histogram snapshot
+    GET /api/jobs             submitted jobs
+    GET /api/cluster          resources + availability
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import api_internal
+
+_state: Dict[str, Any] = {"server": None}
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> str:
+    """Serve the dashboard from a driver thread; returns the URL
+    (reference default port 8265)."""
+    from aiohttp import web
+
+    rt = api_internal.require_runtime()
+
+    async def api_state(request: web.Request):
+        kind = request.match_info["kind"]
+        try:
+            return web.json_response(rt.state_query(kind))
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=404)
+
+    async def api_summary(request):
+        from ray_tpu.util.state import summarize_tasks
+
+        return web.json_response(summarize_tasks())
+
+    async def api_metrics(request):
+        from ray_tpu.util import metrics
+
+        return web.json_response(metrics.snapshot())
+
+    async def api_jobs(request):
+        from ray_tpu.job_submission import _get_manager
+
+        return web.json_response(_get_manager(rt).list())
+
+    async def api_cluster(request):
+        return web.json_response({
+            "resources": rt.cluster_resources(),
+            "available": rt.available_resources(),
+            "session_id": rt.session_id,
+        })
+
+    async def index(request):
+        sections = ["cluster", "summary", "metrics", "jobs", "nodes",
+                    "actors", "tasks", "workers"]
+        links = "".join(
+            f'<li><a href="/api/{s}">/api/{s}</a></li>' for s in sections)
+        return web.Response(
+            text=f"<html><body><h2>ray_tpu dashboard</h2>"
+                 f"<ul>{links}</ul></body></html>",
+            content_type="text/html")
+
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/api/summary", api_summary)
+    app.router.add_get("/api/metrics", api_metrics)
+    app.router.add_get("/api/jobs", api_jobs)
+    app.router.add_get("/api/cluster", api_cluster)
+    app.router.add_get("/api/{kind}", api_state)
+
+    runner = web.AppRunner(app)
+    ready = threading.Event()
+    holder: Dict[str, Any] = {}
+
+    def serve_thread():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, host, port)
+        loop.run_until_complete(site.start())
+        holder["loop"] = loop
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve_thread, daemon=True,
+                         name="ray_tpu-dashboard")
+    t.start()
+    if not ready.wait(10):
+        raise RuntimeError("dashboard failed to start")
+    _state["server"] = (t, runner, holder)
+    return f"http://{host}:{port}"
+
+
+def stop_dashboard():
+    server = _state.get("server")
+    if server:
+        try:
+            server[2]["loop"].call_soon_threadsafe(server[2]["loop"].stop)
+        except Exception:
+            pass
+        _state["server"] = None

@@ -140,6 +140,13 @@ class RemoteFunction:
             self._registered_with = session
         return self._func_id, None
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node instead of immediate submission (reference:
+        python/ray/dag — fn.bind builds a FunctionNode)."""
+        from ray_tpu.dag.node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         rt = require_runtime()
         func_id, payload = self._ensure_registered(rt)

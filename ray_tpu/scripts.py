@@ -1,0 +1,160 @@
+"""Command line interface: ``python -m ray_tpu.scripts <command>``.
+
+Reference: ``python/ray/scripts/scripts.py`` (``ray start`` :529,
+``status`` :1955, ``submit``, job CLI in ``dashboard/modules/job/cli.py``).
+Condensed to the commands that matter for this runtime's topology:
+
+  agent    join a running cluster as a node (the ``ray start`` analog for
+           worker nodes: spawns a node_agent against the head address)
+  status   cluster resources + nodes, over a client connection
+  submit   submit a job (entrypoint command) to the cluster
+  jobs     list jobs;  logs/stop act on one job
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _client(args):
+    from ray_tpu._private.client import client_connect
+
+    key = args.authkey or os.environ.get("RAY_TPU_CLIENT_AUTHKEY")
+    if not key:
+        sys.exit("need --authkey or RAY_TPU_CLIENT_AUTHKEY")
+    return client_connect(args.address, bytes.fromhex(key))
+
+
+def _cmd_agent(args):
+    os.environ["RAY_TPU_HEAD_ADDRESS"] = args.address
+    key = (args.authkey or os.environ.get("RAY_TPU_CLIENT_AUTHKEY")
+           or os.environ.get("RAY_TPU_AUTHKEY"))
+    if not key:
+        sys.exit("need --authkey or RAY_TPU_CLIENT_AUTHKEY")
+    os.environ["RAY_TPU_AUTHKEY"] = key
+    resources = {"CPU": float(args.num_cpus)}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    if args.resources:
+        resources.update(json.loads(args.resources))
+    os.environ["RAY_TPU_AGENT_RESOURCES"] = json.dumps(resources)
+    if args.shm_dir:
+        os.environ["RAY_TPU_AGENT_SHM_DIR"] = args.shm_dir
+    from ray_tpu._private.node_agent import main as agent_main
+
+    agent_main()
+
+
+def _cmd_status(args):
+    rt = _client(args)
+    info = rt.request(lambda rid: ("cluster_info", rid))
+    print(f"session: {info['session_id']}")
+    print(f"resources: {info['resources']}")
+    print(f"available: {info['available']}")
+    print(f"nodes ({len(info['nodes'])}):")
+    for n in info["nodes"]:
+        state = "ALIVE" if n["alive"] else "DEAD"
+        print(f"  {n['node_id'][:12]}  {state:5}  {n['resources']}")
+    rt.disconnect()
+
+
+def _cmd_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address, _authkey=args.authkey)
+    runtime_env = json.loads(args.runtime_env) if args.runtime_env else None
+    import shlex
+
+    entry = args.entrypoint
+    if entry and entry[0] == "--":  # argparse.REMAINDER keeps the separator
+        entry = entry[1:]
+    # Re-quote: the manager shlex-splits the entrypoint string, so argv
+    # tokens with spaces must survive the round trip.
+    job_id = client.submit_job(
+        entrypoint=" ".join(shlex.quote(t) for t in entry),
+        runtime_env=runtime_env)
+    print(f"submitted: {job_id}")
+    if args.follow:
+        for chunk in client.tail_job_logs(job_id, timeout=args.timeout):
+            sys.stdout.write(chunk)
+            sys.stdout.flush()
+        print(f"status: {client.get_job_status(job_id)}")
+
+
+def _cmd_jobs(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address, _authkey=args.authkey)
+    for j in client.list_jobs():
+        print(f"{j['job_id']}  {j['status']:9}  {j['entrypoint']}")
+
+
+def _cmd_logs(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address, _authkey=args.authkey)
+    sys.stdout.write(client.get_job_logs(args.job_id))
+
+
+def _cmd_stop(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address, _authkey=args.authkey)
+    print(client.stop_job(args.job_id))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp):
+        sp.add_argument("--address", required=True,
+                        help="head address, tcp://host:port")
+        sp.add_argument("--authkey", default=None,
+                        help="cluster authkey hex (or env "
+                             "RAY_TPU_CLIENT_AUTHKEY)")
+
+    ag = sub.add_parser("agent", help="join the cluster as a node")
+    common(ag)
+    ag.add_argument("--num-cpus", type=float, default=1.0)
+    ag.add_argument("--num-tpus", type=float, default=0.0)
+    ag.add_argument("--resources", default=None, help="extra resources JSON")
+    ag.add_argument("--shm-dir", default=None)
+    ag.set_defaults(fn=_cmd_agent)
+
+    st = sub.add_parser("status", help="cluster resources + nodes")
+    common(st)
+    st.set_defaults(fn=_cmd_status)
+
+    sb = sub.add_parser("submit", help="submit a job")
+    common(sb)
+    sb.add_argument("--runtime-env", default=None, help="JSON runtime env")
+    sb.add_argument("--follow", action="store_true")
+    sb.add_argument("--timeout", type=float, default=600.0)
+    sb.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sb.set_defaults(fn=_cmd_submit)
+
+    jb = sub.add_parser("jobs", help="list jobs")
+    common(jb)
+    jb.set_defaults(fn=_cmd_jobs)
+
+    lg = sub.add_parser("logs", help="print a job's logs")
+    common(lg)
+    lg.add_argument("job_id")
+    lg.set_defaults(fn=_cmd_logs)
+
+    sp = sub.add_parser("stop", help="stop a running job")
+    common(sp)
+    sp.add_argument("job_id")
+    sp.set_defaults(fn=_cmd_stop)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""ray_tpu.workflow — durable DAG execution (Workflow equivalent).
+
+Reference: ``python/ray/workflow/`` (``workflow_executor.py:32`` state
+machine over checkpointed steps, ``workflow_storage.py`` durable results,
+``workflow_state_from_dag.py`` building runs from DAG nodes).  Same model,
+condensed: ``workflow.run(dag, workflow_id=...)`` executes a
+``ray_tpu.dag`` graph step by step, persisting every node's result (and
+the DAG itself) to local storage; a crash mid-run leaves a RESUMABLE
+workflow whose completed steps are NOT re-executed on
+``workflow.resume(workflow_id)`` — exactly-once per step via checkpoints.
+"""
+
+from ray_tpu.workflow.api import (
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = ["init", "run", "run_async", "resume", "get_output",
+           "get_status", "list_all", "delete"]

@@ -1,0 +1,210 @@
+"""Workflow execution + durable storage.
+
+Reference: ``python/ray/workflow/workflow_executor.py:32`` (executor
+driving a workflow state machine), ``workflow_storage.py`` (step-result
+checkpoints), ``api.py`` (run/resume/get_status surface).  Storage is a
+directory tree::
+
+    <storage>/<workflow_id>/dag.pkl          the bound DAG (cloudpickle)
+    <storage>/<workflow_id>/input.pkl        execute() input
+    <storage>/<workflow_id>/steps/<uuid>.pkl one checkpoint per DAG node
+    <storage>/<workflow_id>/status           RUNNING|SUCCESSFUL|FAILED
+
+Each step runs as a normal task through the DAG node; its materialized
+result checkpoints BEFORE the next step starts, so resume() skips every
+completed step (the reference's exactly-once-per-step contract).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import serialization
+from ray_tpu.dag.node import ClassNode, DAGNode, InputNode
+
+_state: Dict[str, Any] = {"dir": None}
+_lock = threading.Lock()
+
+
+def init(storage: Optional[str] = None):
+    """Set the durable storage root (reference: workflow.init)."""
+    _state["dir"] = storage or os.path.join(
+        os.path.expanduser("~"), ".ray_tpu_workflows")
+    os.makedirs(_state["dir"], exist_ok=True)
+
+
+def _root() -> str:
+    if _state["dir"] is None:
+        init()
+    return _state["dir"]
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_root(), workflow_id)
+
+
+def _write(path: str, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.dumps_inline(obj))
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+
+
+def _read(path: str):
+    with open(path, "rb") as f:
+        return serialization.loads_inline(f.read())
+
+
+def _set_status(workflow_id: str, status: str):
+    with open(os.path.join(_wf_dir(workflow_id), "status"), "w") as f:
+        f.write(status)
+
+
+def get_status(workflow_id: str) -> str:
+    """RUNNING | SUCCESSFUL | FAILED | RESUMABLE | NOT_FOUND."""
+    d = _wf_dir(workflow_id)
+    if not os.path.isdir(d):
+        return "NOT_FOUND"
+    try:
+        with open(os.path.join(d, "status")) as f:
+            s = f.read().strip()
+    except OSError:
+        return "NOT_FOUND"
+    if s == "RUNNING":
+        # A RUNNING marker with no live executor means a crashed run —
+        # surfaced as RESUMABLE (reference: workflow_access.py resumable
+        # detection; our executor is in-process so any RUNNING we did not
+        # start ourselves is a leftover).
+        with _lock:
+            if workflow_id not in _state.get("live", set()):
+                return "RESUMABLE"
+    return s
+
+
+def list_all() -> List[tuple]:
+    root = _root()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        if os.path.isdir(os.path.join(root, wid)):
+            out.append((wid, get_status(wid)))
+    return out
+
+
+def delete(workflow_id: str):
+    import shutil
+
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+
+
+def _execute_durably(dag: DAGNode, workflow_id: str, input_value):
+    """Walk the DAG children-first, checkpointing each node's materialized
+    result; already-checkpointed nodes are loaded, not re-run."""
+    import ray_tpu as ray
+
+    steps_dir = os.path.join(_wf_dir(workflow_id), "steps")
+    os.makedirs(steps_dir, exist_ok=True)
+    memo: Dict[str, Any] = {}
+    order = dag.topo_order()
+    for node in order:
+        ckpt = os.path.join(steps_dir, node._stable_uuid + ".pkl")
+        if isinstance(node, InputNode):
+            memo[node._stable_uuid] = input_value
+            continue
+        if isinstance(node, ClassNode):
+            # Actors are processes, not values: they cannot checkpoint.
+            # Re-instantiated on resume (reference: virtual actors are a
+            # separate subsystem; plain workflow DAG treats them the same
+            # way).
+            memo[node._stable_uuid] = node._execute_impl(memo, (), {})
+            continue
+        if os.path.exists(ckpt):
+            memo[node._stable_uuid] = _read(ckpt)
+            continue
+        ref = node._execute_impl(memo, (input_value,), {})
+        value = ray.get(ref)
+        _write(ckpt, value)
+        memo[node._stable_uuid] = value
+    return memo[order[-1]._stable_uuid]
+
+
+def run(dag: DAGNode, *, workflow_id: str, input_value=None) -> Any:
+    """Execute durably; blocking (reference: workflow.run, api.py)."""
+    d = _wf_dir(workflow_id)
+    os.makedirs(d, exist_ok=True)
+    dag_path = os.path.join(d, "dag.pkl")
+    if not os.path.exists(dag_path):
+        _write(dag_path, dag)
+        _write(os.path.join(d, "input.pkl"), input_value)
+    else:
+        # Re-running an existing id resumes from its STORED dag (stable
+        # step uuids must match the checkpoints on disk).
+        dag = _read(dag_path)
+        input_value = _read(os.path.join(d, "input.pkl"))
+    with _lock:
+        _state.setdefault("live", set()).add(workflow_id)
+    _set_status(workflow_id, "RUNNING")
+    try:
+        out = _execute_durably(dag, workflow_id, input_value)
+    except BaseException:
+        _set_status(workflow_id, "FAILED")
+        raise
+    finally:
+        with _lock:
+            _state.setdefault("live", set()).discard(workflow_id)
+    # Output FIRST, then the status flip: a crash between the two must
+    # never yield a SUCCESSFUL workflow without a stored output.
+    _write(os.path.join(d, "output.pkl"), out)
+    _set_status(workflow_id, "SUCCESSFUL")
+    return out
+
+
+def run_async(dag: DAGNode, *, workflow_id: str, input_value=None):
+    """Like run() but on a daemon thread; returns a Future."""
+    from concurrent.futures import Future
+
+    fut: Future = Future()
+
+    def body():
+        try:
+            fut.set_result(run(dag, workflow_id=workflow_id,
+                               input_value=input_value))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=body, daemon=True,
+                     name=f"workflow-{workflow_id}").start()
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Continue a crashed/failed run from its checkpoints (reference:
+    workflow.resume — completed steps load from storage)."""
+    d = _wf_dir(workflow_id)
+    if not os.path.isdir(d):
+        raise ValueError(f"no workflow {workflow_id!r}")
+    dag = _read(os.path.join(d, "dag.pkl"))
+    input_value = _read(os.path.join(d, "input.pkl"))
+    with _lock:
+        _state.setdefault("live", set()).add(workflow_id)
+    _set_status(workflow_id, "RUNNING")
+    try:
+        out = _execute_durably(dag, workflow_id, input_value)
+    except BaseException:
+        _set_status(workflow_id, "FAILED")
+        raise
+    finally:
+        with _lock:
+            _state.setdefault("live", set()).discard(workflow_id)
+    _write(os.path.join(d, "output.pkl"), out)
+    _set_status(workflow_id, "SUCCESSFUL")
+    return out
+
+
+def get_output(workflow_id: str) -> Any:
+    path = os.path.join(_wf_dir(workflow_id), "output.pkl")
+    if not os.path.exists(path):
+        raise ValueError(f"workflow {workflow_id!r} has no stored output "
+                         f"(status={get_status(workflow_id)})")
+    return _read(path)

@@ -1,0 +1,201 @@
+"""Client mode, job submission, and CLI tests.
+
+Reference patterns: ``python/ray/util/client`` tests (external process
+drives the cluster), ``dashboard/modules/job/tests`` (submit/status/logs/
+stop lifecycle), ``ray status`` CLI.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu as ray
+
+
+@pytest.fixture
+def ray4():
+    rt = ray.init(num_cpus=4)
+    yield rt
+    ray.shutdown()
+
+
+def _client_env(rt):
+    env = dict(os.environ)
+    env["RAY_TPU_CLIENT_ADDRESS"] = rt.tcp_address
+    env["RAY_TPU_CLIENT_AUTHKEY"] = rt._authkey.hex()
+    env["PYTHONPATH"] = ("/root/repo" + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    return env
+
+
+CLIENT_SCRIPT = """
+import numpy as np
+import ray_tpu as ray
+ray.init()  # picks up RAY_TPU_CLIENT_ADDRESS from env
+
+@ray.remote
+def sq(x):
+    return x * x
+
+assert ray.get([sq.remote(i) for i in range(8)], timeout=60) == \
+    [i * i for i in range(8)]
+
+big = np.arange(2_000_000, dtype=np.int64)
+ref = ray.put(big)  # lands in the HEAD's store via put_parts
+
+@ray.remote
+def total(a):
+    return int(a.sum())
+
+assert ray.get(total.remote(ref), timeout=60) == int(big.sum())
+assert int(ray.get(ref, timeout=60).sum()) == int(big.sum())
+
+@ray.remote
+class Acc:
+    def __init__(self):
+        self.v = 0
+
+    def add(self, x):
+        self.v += x
+        return self.v
+
+a = Acc.remote()
+assert ray.get([a.add.remote(1) for _ in range(3)], timeout=60) == [1, 2, 3]
+ray.shutdown()
+print("CLIENT_OK")
+"""
+
+
+def test_client_mode_end_to_end(ray4):
+    p = subprocess.run([sys.executable, "-c", CLIENT_SCRIPT],
+                       env=_client_env(ray4), capture_output=True,
+                       text=True, timeout=180)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "CLIENT_OK" in p.stdout
+
+
+def test_job_submission_lifecycle(ray4):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import os; "
+                   f"print('hello from', os.environ['RAY_TPU_JOB_ID'])\"")
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(job_id) == "SUCCEEDED":
+            break
+        time.sleep(0.3)
+    assert client.get_job_status(job_id) == "SUCCEEDED"
+    assert "hello from" in client.get_job_logs(job_id)
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+
+def test_job_attaches_to_cluster(ray4):
+    """The submitted entrypoint connects back to THIS cluster in client
+    mode and runs tasks on it (reference: jobs are cluster drivers)."""
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    script = ("import ray_tpu as ray; ray.init(); "
+              "f = ray.remote(lambda: 40 + 2); "
+              "print('answer:', ray.get(f.remote(), timeout=60)); "
+              "ray.shutdown()")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"{script}\"")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if client.get_job_status(job_id) not in ("PENDING", "RUNNING"):
+            break
+        time.sleep(0.3)
+    assert client.get_job_status(job_id) == "SUCCEEDED", \
+        client.get_job_logs(job_id)[-2000:]
+    assert "answer: 42" in client.get_job_logs(job_id)
+
+
+def test_job_stop(ray4):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(300)\"")
+    time.sleep(0.5)
+    assert client.stop_job(job_id)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.get_job_status(job_id) == "STOPPED":
+            break
+        time.sleep(0.2)
+    assert client.get_job_status(job_id) == "STOPPED"
+
+
+def test_cli_status_and_submit(ray4):
+    env = _client_env(ray4)
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "status",
+         "--address", ray4.tcp_address, "--authkey", ray4._authkey.hex()],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "resources" in p.stdout and "ALIVE" in p.stdout
+
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "submit",
+         "--address", ray4.tcp_address, "--authkey", ray4._authkey.hex(),
+         "--follow", "--timeout", "90", "--",
+         sys.executable, "-c", "print('cli job ran')"],
+        env=env, capture_output=True, text=True, timeout=150)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "submitted: job_" in p.stdout
+    assert "cli job ran" in p.stdout
+    assert "status: SUCCEEDED" in p.stdout
+
+
+def test_runtime_env_working_dir(ray4, tmp_path):
+    """Tasks with runtime_env working_dir run chdir'ed into (and able to
+    import from) a shipped copy of the directory."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mymod.py").write_text("VALUE = 'from-working-dir'\n")
+    (proj / "data.txt").write_text("payload\n")
+
+    @ray.remote(runtime_env={"working_dir": str(proj)})
+    def uses_dir():
+        import mymod  # importable because cwd/sys.path include the pkg
+
+        return mymod.VALUE, open("data.txt").read().strip()
+
+    assert ray.get(uses_dir.remote(), timeout=60) == \
+        ("from-working-dir", "payload")
+
+
+def test_dashboard_endpoints(ray4):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray.remote
+    def f():
+        return 1
+
+    ray.get([f.remote() for _ in range(3)], timeout=60)
+    url = start_dashboard(port=18265)
+    try:
+        def get(path):
+            with urllib.request.urlopen(url + path, timeout=10) as r:
+                return json.loads(r.read())
+
+        cluster = get("/api/cluster")
+        assert cluster["resources"].get("CPU") == 4.0
+        nodes = get("/api/nodes")
+        assert nodes and nodes[0]["alive"]
+        tasks = get("/api/tasks")
+        assert sum(1 for t in tasks if t["state"] == "FINISHED") >= 3
+        assert isinstance(get("/api/summary"), dict)
+        assert isinstance(get("/api/metrics"), dict)
+        assert get("/api/jobs") == []
+    finally:
+        stop_dashboard()

@@ -1,0 +1,143 @@
+"""DAG API + Workflow tests.
+
+Reference patterns: ``python/ray/dag/tests`` (bind/execute graphs) and
+``python/ray/workflow/tests`` (durable resume skips completed steps).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture
+def ray8(tmp_path):
+    rt = ray.init(num_cpus=8)
+    workflow.init(str(tmp_path / "wf"))
+    yield rt
+    ray.shutdown()
+
+
+@ray.remote
+def add(a, b):
+    return a + b
+
+
+@ray.remote
+def double(x):
+    return x * 2
+
+
+def test_function_dag_execute(ray8):
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), double.bind(3))
+    # (5*2) + (3*2)
+    assert ray.get(dag.execute(5), timeout=60) == 16
+
+
+def test_dag_node_runs_once_per_execute(ray8):
+    marker = f"/tmp/rtpu_dag_{os.getpid()}"
+    if os.path.exists(marker):
+        os.remove(marker)
+
+    @ray.remote
+    def effect():
+        with open(marker, "a") as f:
+            f.write("x")
+        return 1
+
+    shared = effect.bind()
+    dag = add.bind(shared, shared)  # diamond: shared executes ONCE
+    assert ray.get(dag.execute(), timeout=60) == 2
+    assert open(marker).read() == "x"
+    os.remove(marker)
+
+
+def test_actor_dag(ray8):
+    @ray.remote
+    class Acc:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    node = Acc.bind(10)
+    dag = node.add.bind(node.add.bind(5))  # 10+5=15, then +15=30
+    assert ray.get(dag.execute(), timeout=60) == 30
+
+
+def test_workflow_run_and_output(ray8):
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 1)
+    out = workflow.run(dag, workflow_id="w1", input_value=4)
+    assert out == 9
+    assert workflow.get_status("w1") == "SUCCESSFUL"
+    assert workflow.get_output("w1") == 9
+    assert ("w1", "SUCCESSFUL") in workflow.list_all()
+
+
+def test_workflow_resume_skips_completed_steps(ray8):
+    marker = f"/tmp/rtpu_wf_{os.getpid()}"
+    for suffix in ("a", "b"):
+        if os.path.exists(marker + suffix):
+            os.remove(marker + suffix)
+
+    @ray.remote
+    def step_a():
+        with open(marker + "a", "a") as f:
+            f.write("x")
+        return 10
+
+    @ray.remote
+    def step_b(v):
+        with open(marker + "b", "a") as f:
+            f.write("x")
+        if len(open(marker + "b").read()) == 1:
+            raise RuntimeError("transient failure")  # first attempt dies
+        return v + 1
+
+    dag = step_b.bind(step_a.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == "FAILED"
+    out = workflow.resume("w2")
+    assert out == 11
+    # step_a checkpointed on attempt 1 and was NOT re-executed by resume
+    assert open(marker + "a").read() == "x"
+    assert open(marker + "b").read() == "xx"
+    os.remove(marker + "a")
+    os.remove(marker + "b")
+
+
+def test_workflow_rerun_same_id_loads_checkpoints(ray8):
+    marker = f"/tmp/rtpu_wf2_{os.getpid()}"
+    if os.path.exists(marker):
+        os.remove(marker)
+
+    @ray.remote
+    def once():
+        with open(marker, "a") as f:
+            f.write("x")
+        return 7
+
+    dag = double.bind(once.bind())
+    assert workflow.run(dag, workflow_id="w3") == 14
+    # run again with the SAME id: everything loads from checkpoints
+    assert workflow.run(dag, workflow_id="w3") == 14
+    assert open(marker).read() == "x"
+    os.remove(marker)
+
+
+def test_workflow_run_async_and_delete(ray8):
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    fut = workflow.run_async(dag, workflow_id="w4", input_value=21)
+    assert fut.result(timeout=60) == 42
+    workflow.delete("w4")
+    assert workflow.get_status("w4") == "NOT_FOUND"
