@@ -159,7 +159,7 @@ class WorkerHandle:
         "inflight", "actor_id", "tpu_chips", "idle_since", "released",
         "ready", "dead", "outbox", "spawned_at",
         "lease_key", "lease_req", "lease_pg", "blocked",
-        "pending_force_kill",
+        "pending_force_kill", "direct_addr", "client_lease",
     )
 
     def __init__(self, worker_id, conn, proc, node, env_key, tpu_chips):
@@ -190,6 +190,12 @@ class WorkerHandle:
         # Set by force-cancel: victim task id; the proc is terminated only
         # after a steal pass rescues the other pipelined tasks.
         self.pending_force_kill: Optional[bytes] = None
+        # Direct-push endpoint (reported in the worker's "ready") and, when
+        # leased to a peer caller, that caller's WorkerHandle (the head
+        # only does resource accounting for such leases; tasks/results
+        # bypass it entirely — direct_task_transport.cc:568).
+        self.direct_addr = None
+        self.client_lease: Optional["WorkerHandle"] = None
 
     def send(self, msg):
         with self.send_lock:
@@ -317,6 +323,13 @@ class PlacementGroupState:
         self.used: List[Dict[str, float]] = [dict() for _ in bundles]
 
 
+def worker_send_safe(worker: "WorkerHandle", msg):
+    try:
+        worker.send(msg)
+    except Exception:
+        pass  # requester died; its death path cleans up
+
+
 class Runtime:
     """The driver's runtime.  Public API (api.py) and ObjectRef route here."""
 
@@ -365,6 +378,7 @@ class Runtime:
         self._conn_to_agent: Dict[Any, AgentHandle] = {}
         self._agents: Dict[str, AgentHandle] = {}  # store_id -> handle
         self._pending_workers: Dict[str, WorkerHandle] = {}
+        self._workers_by_hex: Dict[str, WorkerHandle] = {}
         # Direct chunked pulls from remote object servers (reference:
         # ObjectManager::Pull); the head-relay path remains as fallback
         # and counts its uses (tests assert it stays cold).
@@ -1504,9 +1518,12 @@ class Runtime:
                 if w is None or w.dead:
                     conn.close()
                     continue
+                if len(msg) > 3:
+                    w.direct_addr = msg[3]
                 w.attach(conn)
                 w.ready.set()
                 self._conn_to_worker[conn] = w
+                self._workers_by_hex[worker_id_hex] = w
             # One reader thread per connection (replaces the old select
             # loop): recv/unpickle for different workers runs in parallel,
             # and a burst from one worker is drained back-to-back instead
@@ -1535,6 +1552,52 @@ class Runtime:
                          daemon=True, name="ray_tpu-rx-agent").start()
         with self.lock:
             self._dispatch_locked()
+
+    def _grant_client_leases(self, lessee: WorkerHandle, rid,
+                             resources: Dict[str, float], n: int):
+        """Lease up to ``n`` workers to a peer caller for direct task
+        push.  The head acquires node resources (exactly like a dispatch
+        lease) but never sees the tasks; the caller returns the lease via
+        ("lease_return", ...) or by dying (reference: raylet
+        RequestWorkerLease / ReturnWorker)."""
+        req = {k: float(v) for k, v in resources.items()}
+        granted: List[WorkerHandle] = []
+        with self.lock:
+            for _ in range(max(1, n)):
+                pseudo = TaskRecord(
+                    {"resources": req, "num_returns": 0,
+                     "name": "client_lease", "task_id": b""}, req, 0)
+                node = self._pick_node_locked(pseudo)
+                if node is None:
+                    break
+                node.acquire(req)
+                pseudo.node = node
+                w = self._lease_worker_locked(node, pseudo, [])
+                w.lease_req = dict(req)
+                w.client_lease = lessee
+                granted.append(w)
+        if not granted:
+            worker_send_safe(lessee, ("reply", rid, []))
+            return
+
+        def finish():
+            out, failed = [], []
+            for w in granted:
+                if (w.ready.wait(timeout=30.0) and w.direct_addr
+                        and not w.dead):
+                    out.append((w.worker_id.hex(), tuple(w.direct_addr)))
+                else:
+                    failed.append(w)
+            if failed:
+                with self.lock:
+                    for w in failed:
+                        w.client_lease = None
+                        if not w.dead:
+                            self._end_lease_locked(w)
+            worker_send_safe(lessee, ("reply", rid, out))
+
+        threading.Thread(target=finish, daemon=True,
+                         name="ray_tpu-lease-grant").start()
 
     def _send_task(self, worker: WorkerHandle, rec: TaskRecord):
         spec = rec.spec
@@ -2182,6 +2245,89 @@ class Runtime:
                     if st is not None:
                         st.worker_refs -= 1
                         self._maybe_free_locked(oid, st)
+        elif tag == "addref_batch":
+            with self.lock:
+                for b in msg[1]:
+                    oid = ObjectID(b)
+                    st = self.objects.get(oid)
+                    if st is None:
+                        st = self.objects[oid] = ObjectState()
+                    st.worker_refs += 1
+        elif tag == "lease_req":
+            # A caller wants executor workers to push tasks to directly;
+            # the head only does the resource accounting (reference: the
+            # raylet's RequestWorkerLease, direct_task_transport.cc:568).
+            self._grant_client_leases(worker, msg[1], msg[2], msg[3])
+        elif tag == "lease_return":
+            with self.lock:
+                for wid in msg[1]:
+                    w = self._workers_by_hex.get(wid)
+                    if w is not None and w.client_lease is not None \
+                            and not w.dead:
+                        w.client_lease = None
+                        self._end_lease_locked(w)
+                self._dispatch_locked()
+        elif tag == "export_obj":
+            # A worker delegates ownership of objects it created to the
+            # head (they are about to be consumed through head-routed
+            # specs or returned values).  worker_refs starts at 1: one
+            # aggregate ref standing for all of the exporter's local refs.
+            with self.lock:
+                for item in msg[1]:
+                    b, ok, descr, nested = item[0], item[1], item[2], item[3]
+                    creator_hex = item[4] if len(item) > 4 else None
+                    oid = ObjectID(b)
+                    st = self.objects.get(oid)
+                    if st is None:
+                        st = self.objects[oid] = ObjectState()
+                    st.worker_refs += 1
+                    if ok is None:
+                        continue  # pending shell; export_complete follows
+                    st.status = READY if ok else ERRORED
+                    st.descr = descr
+                    if descr is not None and descr[0] == protocol.SHM:
+                        cw = (self._workers_by_hex.get(creator_hex)
+                              if creator_hex else worker)
+                        if cw is not None and not cw.dead:
+                            st.creator = cw
+                        st.shipped = True
+                    st.nested_ids = list(nested)
+                    self._pin_nested_locked(st.nested_ids)
+        elif tag == "export_complete":
+            with self.lock:
+                for item in msg[1]:
+                    b, ok, descr = item[0], item[1], item[2]
+                    nested = item[3] if len(item) > 3 else []
+                    creator_hex = item[4] if len(item) > 4 else None
+                    oid = ObjectID(b)
+                    st = self.objects.get(oid)
+                    if st is not None and nested:
+                        st.nested_ids = list(nested)
+                        self._pin_nested_locked(st.nested_ids)
+                    if st is not None and descr is not None \
+                            and descr[0] == protocol.SHM:
+                        st.shipped = True
+                    cw = (self._workers_by_hex.get(creator_hex)
+                          if creator_hex else None)
+                    self._complete_object_locked(oid, descr, bool(ok),
+                                                 creator=cw)
+        elif tag == "free_remote":
+            # Owner-side free of a segment homed in another store (its
+            # direct conn to the creator is gone): route the unlink.
+            _, name, size, store_hex = msg
+            if store_hex == self.store_id:
+                try:
+                    self.shm.unlink(name, size, reusable=False)
+                except Exception:
+                    pass
+            else:
+                with self.lock:
+                    agent = self._agents.get(store_hex)
+                if agent is not None and not agent.dead:
+                    try:
+                        agent.send(("unlink_segment", name, size))
+                    except Exception:
+                        pass
         elif tag == "mget":
             self._on_worker_mget(worker, msg[1], msg[2], msg[3])
         elif tag == "blocked":
@@ -2404,6 +2550,7 @@ class Runtime:
     def _kill_worker_locked(self, worker: WorkerHandle):
         worker.dead = True
         self._conn_to_worker.pop(worker.conn, None)
+        self._workers_by_hex.pop(worker.worker_id.hex(), None)
         worker.node.all_workers.pop(id(worker), None)
         self.worker_funcs.pop(id(worker), None)
         try:
@@ -2421,11 +2568,21 @@ class Runtime:
                 return
             worker.dead = True
             self._conn_to_worker.pop(worker.conn, None)
+            self._workers_by_hex.pop(worker.worker_id.hex(), None)
             worker.node.all_workers.pop(id(worker), None)
             self.worker_funcs.pop(id(worker), None)
             for key, lst in worker.node.idle_workers.items():
                 if worker in lst:
                     lst.remove(worker)
+            # Workers this one had leased for direct push return to the
+            # pool (their direct conns EOF on their own).
+            for node in self.nodes.values():
+                for w in list(node.all_workers.values()):
+                    if w.client_lease is worker:
+                        w.client_lease = None
+                        if not w.dead:
+                            self._end_lease_locked(w)
+            worker.client_lease = None
             if worker.actor_id is not None:
                 self._on_actor_worker_death(worker)
                 return

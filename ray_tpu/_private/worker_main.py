@@ -31,6 +31,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import direct as direct_mod
 from ray_tpu._private import object_transfer, protocol, serialization
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, new_task_id
 from ray_tpu._private import object_ref as object_ref_mod
@@ -95,6 +96,39 @@ class _WorkerRuntime:
         # actor's lone reply must go out immediately, not on the 0.25s
         # timer.
         self.queue_empty = lambda: True
+        # Caller-side ownership + direct push (reference:
+        # direct_task_transport.cc:568 + reference_count.h:61 — this
+        # worker OWNS its puts and its direct-submitted tasks' returns;
+        # the head is only the lease scheduler for them).
+        self._fn_payloads: Dict[str, bytes] = {}
+        self.direct = direct_mod.DirectCaller(self)
+
+    # -- DirectCaller host adapter -----------------------------------------
+    def head_request(self, msg_builder):
+        return self._request(msg_builder)
+
+    def head_send(self, msg):
+        # Raw send: no decref-buffer flush (this is called from within the
+        # decref-processing path itself; flushing would recurse into
+        # send_lock).
+        with self.send_lock:
+            protocol.send(self.conn, msg)
+
+    def dial(self, addr):
+        from multiprocessing.connection import Client
+
+        return Client(tuple(addr),
+                      authkey=bytes.fromhex(
+                          os.environ.get("RAY_TPU_AUTHKEY", "")))
+
+    def get_payload(self, func_id: str) -> Optional[bytes]:
+        return self._fn_payloads.get(func_id)
+
+    def submit_via_head(self, spec: dict):
+        # Rerouted specs may carry owned refs: make them head-visible
+        # first (same-conn FIFO puts the export before the spec).
+        self._export_for_head_path(spec)
+        self._send(("submit", 0, spec))
 
     @property
     def current_task_id(self) -> Optional[TaskID]:
@@ -119,12 +153,25 @@ class _WorkerRuntime:
             self._local_cache.popitem(last=False)
 
     # -- plumbing ----------------------------------------------------------
-    def _send(self, msg):
+    def _drain_decrefs(self) -> list:
+        """Pop the buffered ref drops and apply the OWNED ones locally;
+        returns the bins that belong to the head.  Runs outside send_lock
+        (owned frees may message lease conns / the head)."""
         with self._decref_lock:
             buf, self._decref_buf = self._decref_buf, []
+        if not buf:
+            return buf
+        head_bins = []
+        for b in buf:
+            if not self.direct.decref(ObjectID(b)):
+                head_bins.append(b)
+        return head_bins
+
+    def _send(self, msg):
+        head_bins = self._drain_decrefs()
         with self.send_lock:
-            if buf:
-                protocol.send(self.conn, ("decref_batch", buf))
+            if head_bins:
+                protocol.send(self.conn, ("decref_batch", head_bins))
             protocol.send(self.conn, msg)
 
     def send_result(self, entry):
@@ -148,12 +195,11 @@ class _WorkerRuntime:
             self._send(("result_batch", buf))
 
     def flush_decrefs(self):
-        with self._decref_lock:
-            if not self._decref_buf:
-                return
-            buf, self._decref_buf = self._decref_buf, []
+        head_bins = self._drain_decrefs()
+        if not head_bins:
+            return
         with self.send_lock:
-            protocol.send(self.conn, ("decref_batch", buf))
+            protocol.send(self.conn, ("decref_batch", head_bins))
 
     def _request(self, msg_builder):
         req_id = next(self.req_counter)
@@ -242,6 +288,8 @@ class _WorkerRuntime:
 
     # -- runtime accessor API (mirrors driver Runtime) ---------------------
     def add_local_reference(self, object_id: ObjectID):
+        if self.direct.addref(object_id):
+            return
         self._send(("addref", object_id.binary()))
 
     def remove_local_reference(self, object_id: ObjectID):
@@ -273,31 +321,58 @@ class _WorkerRuntime:
         return out
 
     def get_objects(self, refs, timeout=None):
-        """Batched get: ONE round trip for all non-cached refs (reference:
-        CoreWorker::Get takes the whole id list, core_worker.cc:1250 — the
-        per-ref chatter of v1 was the multi-client bottleneck)."""
+        """Batched get: owned refs resolve against the local ownership
+        table (zero head traffic — the caller IS the metadata authority,
+        reference_count.h:61); the rest go to the head in ONE round trip
+        (CoreWorker::Get, core_worker.cc:1250)."""
         values = [None] * len(refs)
+        owned = []
         missing = []
         for i, ref in enumerate(refs):
             oid = ref.id()
             if oid in self._local_cache:
                 values[i] = self._local_cache[oid]
+            elif self.direct.status_of(oid) not in (None,
+                                                    direct_mod.DELEGATED):
+                owned.append((i, oid))
             else:
                 missing.append((i, oid))
-        if not missing:
+        if not owned and not missing:
             return values
         tid = self.current_task_id
         self._send(("blocked", tid.binary() if tid else b""))
         try:
-            reply = self._request(
-                lambda rid: ("mget", rid,
-                             [oid.binary() for _, oid in missing], timeout))
+            if owned:
+                done = self.direct.wait_owned([o for _, o in owned],
+                                              timeout)
+                if not done:
+                    raise exc.GetTimeoutError(
+                        f"Timed out getting owned objects after {timeout}s")
+                for i, oid in owned:
+                    if self.direct.status_of(oid) in (
+                            None, direct_mod.DELEGATED):
+                        # Delegated to the head mid-get (lease starvation
+                        # reroute): the head is the authority now.
+                        missing.append((i, oid))
+                        continue
+                    descr, st = self.direct.descr_of(oid)
+                    if descr[0] == protocol.ERROR:
+                        raise self.materialize_error(descr)
+                    values[i] = self.materialize(descr)
+                    if descr[0] == protocol.SHM:
+                        st.attached = True
+                    self._cache_put(oid, values[i])
+            if missing:
+                reply = self._request(
+                    lambda rid: ("mget", rid,
+                                 [oid.binary() for _, oid in missing],
+                                 timeout))
+                for (i, _oid), (ok, descr) in zip(missing, reply):
+                    if not ok:
+                        raise self.materialize_error(descr)
+                    values[i] = self.materialize(descr)
         finally:
             self._send(("unblocked", tid.binary() if tid else b""))
-        for (i, _oid), (ok, descr) in zip(missing, reply):
-            if not ok:
-                raise self.materialize_error(descr)
-            values[i] = self.materialize(descr)
         return values
 
     def materialize_error(self, descr):
@@ -311,24 +386,82 @@ class _WorkerRuntime:
         self._send(("event", topic, payload))
 
     def put_object(self, value) -> ObjectRef:
+        """Owner-local put: the value lands in this node's store and the
+        descriptor stays HERE — no head message at all (reference: plasma
+        put + owner-resident metadata; the v1 design registered every put
+        at the head, which serialized multi-client put bandwidth through
+        one mailbox)."""
+        # Apply buffered ref drops first: a put loop's previous segment is
+        # freed (and its pages pooled) BEFORE the next allocation, keeping
+        # the loop at memcpy speed.  Head-owned drops go back in the
+        # buffer — they ride out with the next head message as usual.
+        head_bins = self._drain_decrefs()
+        if head_bins:
+            with self._decref_lock:
+                self._decref_buf[:0] = head_bins
         oid = ObjectID.for_put()
         self.begin_ref_collection()
         try:
             descr = self.serialize_value(value, oid)
         finally:
             nested = self.end_ref_collection()
-        self._send(("put", oid.binary(), descr, nested))
+        nested_local, nested_head = [], []
+        for b in nested:
+            if self.direct.status_of(ObjectID(b)) not in (
+                    None, direct_mod.DELEGATED):
+                nested_local.append(b)
+            else:
+                nested_head.append(b)
+        if nested_head:
+            # Foreign refs nested in the value: hold +1 at the head for
+            # this entry's lifetime (pairs with the decref on local free).
+            self._send(("addref_batch", nested_head))
+        self.direct.register_put(oid, descr, nested_local, nested_head)
         self._cache_put(oid, value)
-        return ObjectRef(oid)
+        return ObjectRef(oid, _register=False)
+
+    def _export_for_head_path(self, spec: dict):
+        """A spec routed through the head may carry owned refs (args or
+        nested): make them head-visible first (ordering: the export rides
+        the same FIFO conn, so it lands before the spec)."""
+        bins = set()
+        for a in spec.get("args", ()):
+            if a[0] == "ref":
+                bins.add(a[1])
+        for v in (spec.get("kwargs") or {}).values():
+            if v[0] == "ref":
+                bins.add(v[1])
+        bins.update(spec.get("nested_refs", ()))
+        owned = [b for b in bins
+                 if self.direct.status_of(ObjectID(b))
+                 not in (None, direct_mod.DELEGATED)]
+        if owned:
+            self.direct.export_refs(owned)
 
     def submit_task(self, spec: dict) -> list:
-        """Nested task submission from inside a worker — fire-and-forget
-        (reference: PushNormalTask pipelines submissions without blocking,
-        direct_task_transport.cc:568).  Safe without an ack because messages
-        on this connection are FIFO: any later get/decref/nested-use of the
-        returned refs is processed by the driver after the submit itself."""
-        self._send(("submit", 0, spec))
+        """Task submission from inside a worker.  Direct-eligible specs
+        are pushed straight to leased peer workers with caller-owned
+        returns (direct_task_transport.cc:568); the rest go through the
+        head scheduler fire-and-forget (per-conn FIFO makes later uses of
+        the returned refs safe)."""
         tid = TaskID(spec["task_id"])
+        if spec.get("func_payload") is not None:
+            self._fn_payloads.setdefault(spec["func_id"],
+                                         spec["func_payload"])
+        if self.direct.eligible(spec):
+            owned_nested = [
+                b for b in spec.get("nested_refs", ())
+                if self.direct.status_of(ObjectID(b))
+                not in (None, direct_mod.DELEGATED)]
+            if owned_nested:
+                # Containers in args embed these refs; the executor
+                # resolves them through the head, so export first.
+                self.direct.export_refs(owned_nested)
+            self.direct.submit(spec)
+            return [ObjectRef(tid.object_id(i), _register=False)
+                    for i in range(spec["num_returns"])]
+        self._export_for_head_path(spec)
+        self._send(("submit", 0, spec))
         # _register=False: the driver counts this worker's reference when it
         # receives the spec (see Runtime.submit_task_from_worker).
         return [ObjectRef(tid.object_id(i), _register=False)
@@ -338,21 +471,47 @@ class _WorkerRuntime:
         # Same blocked/unblocked envelope as get_objects: the lease's CPU
         # slot is released while this worker sits in ray.wait, so tasks
         # stolen off its pipeline (or anyone else) can actually run.
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         tid = self.current_task_id
         self._send(("blocked", tid.binary() if tid else b""))
         try:
-            reply = self._request(
-                lambda rid: (
-                    "wait",
-                    rid,
-                    [r.id().binary() for r in refs],
-                    num_returns,
-                    timeout,
-                )
-            )
+            while True:
+                left = (None if deadline is None
+                        else max(0.0, deadline - _time.monotonic()))
+                owned, foreign = self.direct.split_refs(refs)
+                if not foreign:
+                    ready, delegated = self.direct.wait_owned_n(
+                        [r.id() for r in owned], num_returns, left)
+                    ready_bin = set(ready)
+                    if delegated and len(ready_bin) < num_returns and (
+                            left is None or left > 0):
+                        continue  # re-split: some refs moved to the head
+                    break
+                if not owned:
+                    ready_bin = set(self._request(
+                        lambda rid: ("wait", rid,
+                                     [r.id().binary() for r in refs],
+                                     num_returns, left)))
+                    break
+                # Mixed ownership: poll both authorities (rare path).
+                ready, _delegated = self.direct.wait_owned_n(
+                    [r.id() for r in owned], num_returns, 0)
+                ready_bin = set(ready)
+                if len(ready_bin) < num_returns:
+                    ready_bin.update(self._request(
+                        lambda rid: ("wait", rid,
+                                     [r.id().binary() for r in foreign],
+                                     num_returns - len(ready_bin), 0.05)))
+                if len(ready_bin) >= num_returns:
+                    break
+                if deadline is not None and \
+                        _time.monotonic() >= deadline:
+                    break
+                _time.sleep(0.005)
         finally:
             self._send(("unblocked", tid.binary() if tid else b""))
-        ready_bin = set(reply)
         ready = [r for r in refs if r.id().binary() in ready_bin]
         not_ready = [r for r in refs if r.id().binary() not in ready_bin]
         return ready, not_ready
@@ -374,14 +533,20 @@ def get_worker_runtime() -> Optional[_WorkerRuntime]:
 
 
 class _FunctionCache:
-    def __init__(self):
+    def __init__(self, rt: Optional["_WorkerRuntime"] = None):
         self._fns: Dict[str, Any] = {}
+        self._rt = rt
 
     def has(self, func_id: str) -> bool:
         return func_id in self._fns
 
     def put(self, func_id: str, payload: bytes):
         self._fns[func_id] = serialization.loads_inline(payload)
+        # Raw payloads kept so this worker can re-push definitions to
+        # executors it leases directly (reference: the function table is
+        # content-addressed and shippable by any holder).
+        if self._rt is not None:
+            self._rt._fn_payloads[func_id] = payload
 
     def get(self, func_id: str):
         return self._fns[func_id]
@@ -394,6 +559,7 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
     Reference: _raylet.pyx:702 execute_task — deserialize args, invoke,
     store returns (small inline to owner, large to plasma/shm)."""
     task_id = TaskID(task["task_id"])
+    dreply = task.pop("_dreply", None)
     rt.current_task_id = task_id
     num_returns = task["num_returns"]
     name = task.get("name", "task")
@@ -412,12 +578,20 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
             if asyncio.iscoroutine(result):
                 result = _run_coroutine(result)
         returns = _pack_returns(rt, task_id, result, num_returns)
-        rt.send_result((task["task_id"], True, returns, {}))
+        if dreply is not None:
+            # Direct-pushed task: the reply goes straight to the owning
+            # caller on its connection, never through the head.
+            dreply[0].reply(dreply[1], True, returns, {})
+        else:
+            rt.send_result((task["task_id"], True, returns, {}))
     except Exception as e:  # noqa: BLE001 — task errors become objects
         err = exc.TaskError.from_exception(name, e)
         payload = _pickle_error(err)
         returns = [(protocol.ERROR, payload)] * max(1, num_returns)
-        rt.send_result((task["task_id"], False, returns, {}))
+        if dreply is not None:
+            dreply[0].reply(dreply[1], False, returns, {})
+        else:
+            rt.send_result((task["task_id"], False, returns, {}))
     finally:
         rt.current_task_id = None
         rt.current_actor_id = None
@@ -456,10 +630,27 @@ def _pack_returns(rt: _WorkerRuntime, task_id: TaskID, result, num_returns):
                 f"{len(values)} values"
             )
     out = []
+    nested_all = []
     for i, v in enumerate(values):
         oid = task_id.object_id(i)
-        out.append(rt.serialize_value(v, oid))
+        rt.begin_ref_collection()
+        try:
+            out.append(rt.serialize_value(v, oid))
+        finally:
+            nested_all.extend(rt.end_ref_collection())
         rt._cache_put(oid, v)
+    if nested_all:
+        # Returned values embed ObjectRefs: any owned by THIS worker must
+        # become head-visible before the consumer tries to use them
+        # (simplified borrow protocol — the consumer's addref/get go to
+        # the head).
+        from ray_tpu._private import direct as _dm
+
+        owned = [b for b in nested_all
+                 if rt.direct.status_of(ObjectID(b))
+                 not in (None, _dm.DELEGATED)]
+        if owned:
+            rt.direct.export_refs(owned)
     return out
 
 
@@ -563,7 +754,7 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
     _runtime = rt
     object_ref_mod._set_runtime_accessor(lambda: _runtime)
 
-    fns = _FunctionCache()
+    fns = _FunctionCache(rt)
     actors: Dict[bytes, Any] = {}
     # Deque + condition (not SimpleQueue) so the driver can steal back
     # queued-but-unstarted tasks when this worker blocks in ray.get
@@ -629,6 +820,18 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
 
     threading.Thread(target=reader, daemon=True, name="ray_tpu-reader").start()
 
+    # Direct-push server: peer workers that leased THIS worker connect
+    # here and push tasks into the same execution queue (reference: the
+    # core worker's PushTask service, core_worker.cc HandlePushTask).
+    def direct_enqueue(task: dict, _src):
+        with tq_cv:
+            tasks.append(("exec", task))
+            tq_cv.notify()
+
+    direct_server = direct_mod.DirectServer(
+        bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")),
+        direct_enqueue, fns.put, rt.shm.unlink)
+
     def decref_flusher():
         import time as _time
 
@@ -644,7 +847,8 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
 
     threading.Thread(target=decref_flusher, daemon=True,
                      name="ray_tpu-decref").start()
-    protocol.send(conn, ("ready", worker_id_hex, os.getpid()))
+    protocol.send(conn, ("ready", worker_id_hex, os.getpid(),
+                         direct_server.address))
 
     # After the handshake (the accept loop requires "ready" first): fetch
     # and enter the working_dir package before any task executes — exec
