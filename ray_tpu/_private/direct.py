@@ -119,6 +119,10 @@ class DirectCaller:
         # dep oid_bin -> [entries waiting on it] (caller-side resolution)
         self._dep_waiters: Dict[bytes, list] = {}
         self._pending_exports: set = set()
+        # Outbound free/decref messages produced under self.lock; sent
+        # after release (a peer's full TCP buffer must never stall the
+        # whole ownership table).
+        self._outbound: List[tuple] = []
 
     # ------------------------------------------------------------- owned --
     def register_put(self, oid: ObjectID, descr, nested_local, nested_head):
@@ -155,7 +159,8 @@ class DirectCaller:
                 return False
             st.local_refs -= 1
             self._maybe_free_locked(oid, st)
-            return True
+        self._flush_outbound()
+        return True
 
     def _maybe_free_locked(self, oid: ObjectID, st: OwnedState):
         if st.local_refs > 0 or st.pins > 0:
@@ -168,46 +173,39 @@ class DirectCaller:
         self.owned.pop(oid, None)
         if st.status == DELEGATED:
             # Head holds one aggregate ref for this process.
-            try:
-                self.host.head_send(("decref", oid.binary()))
-            except Exception:
-                pass
+            self._outbound.append(("head", ("decref", oid.binary())))
         elif st.descr is not None and st.descr[0] == protocol.SHM:
-            self._free_segment(st)
+            self._free_segment_locked(st)
         elif st.descr is not None and st.descr[0] == protocol.SPILLED:
-            try:
-                if st.descr[3] == self.host.store_id:
+            if st.descr[3] == self.host.store_id:
+                try:
                     os.unlink(st.descr[1])
-                else:
-                    self.host.head_send(("free_remote", st.descr[1],
-                                         st.descr[2], st.descr[3]))
-            except Exception:
-                pass
+                except OSError:
+                    pass
+            else:
+                self._outbound.append(("head", ("free_remote", st.descr[1],
+                                                st.descr[2], st.descr[3])))
         for b in st.nested_local:
             inner = self.owned.get(ObjectID(b))
             if inner is not None:
                 inner.pins -= 1
                 self._maybe_free_locked(ObjectID(b), inner)
         if st.nested_head:
-            try:
-                self.host.head_send(("decref_batch", list(st.nested_head)))
-            except Exception:
-                pass
+            self._outbound.append(
+                ("head", ("decref_batch", list(st.nested_head))))
 
-    def _free_segment(self, st: OwnedState):
+    def _free_segment_locked(self, st: OwnedState):
         name, size = st.descr[1], st.descr[2]
         store = st.descr[3] if len(st.descr) > 3 else self.host.store_id
         lease = st.creator
         if lease is not None and not lease.dead and lease.conn is not None:
             # The creating worker pools its pages for in-place reuse iff no
             # other process ever mapped the segment.
-            try:
-                lease.send(("dfree", name, size,
-                            not st.attached and not st.shipped))
-                return
-            except Exception:
-                pass
-        if store == self.host.store_id:
+            self._outbound.append(
+                ("lease", lease,
+                 ("dfree", name, size, not st.attached and not st.shipped),
+                 ("free_remote", name, size, store)))
+        elif store == self.host.store_id:
             try:
                 # Self-created segments (owner-local puts) whose descriptor
                 # never escaped pool their pages for in-place reuse — this
@@ -220,10 +218,31 @@ class DirectCaller:
             except Exception:
                 pass
         else:
-            try:
-                self.host.head_send(("free_remote", name, size, store))
-            except Exception:
-                pass
+            self._outbound.append(
+                ("head", ("free_remote", name, size, store)))
+
+    def _flush_outbound(self):
+        if not self._outbound:
+            return
+        with self.lock:
+            out, self._outbound = self._outbound, []
+        for item in out:
+            if item[0] == "lease":
+                _kind, lease, msg, fallback = item
+                try:
+                    lease.send(msg)
+                    continue
+                except Exception:
+                    pass
+                try:
+                    self.host.head_send(fallback)
+                except Exception:
+                    pass
+            else:
+                try:
+                    self.host.head_send(item[1])
+                except Exception:
+                    pass
 
     # ------------------------------------------------------------ submit --
     def eligible(self, spec: dict) -> bool:
@@ -314,7 +333,6 @@ class DirectCaller:
         more leases (or fall back to the head) when short."""
         to_push: List[Tuple[_Lease, dict]] = []
         need_leases = 0
-        fallback: List[dict] = []
         with self.lock:
             pool = self.pools.get(klass)
             if pool is None:
@@ -345,8 +363,6 @@ class DirectCaller:
                                       max(1, len(q) // PIPELINE_DEPTH))
         for lease, entry in to_push:
             self._push_one(lease, entry)
-        for entry in fallback:
-            self._reroute_to_head(entry)
         if need_leases:
             threading.Thread(
                 target=self._request_leases, args=(klass, need_leases),
@@ -491,18 +507,20 @@ class DirectCaller:
                 st.descr = descr
                 if descr[0] == protocol.SHM:
                     st.creator = lease
-                if i < len(nested):
-                    st.nested_head = list(nested[i])
                 self._maybe_free_locked(oid, st)
             self._unpin_entry_locked(entry)
-            self._wake_deps_locked(entry)
+            dep_klasses = self._wake_deps_locked(entry)
             self.cv.notify_all()
         if exported:
             try:
                 self.host.head_send(("export_complete", exported))
             except Exception:
                 pass
+        self._flush_outbound()
         self._pump(lease.klass)
+        for klass in dep_klasses:
+            if klass != lease.klass:
+                self._pump(klass)
 
     def _unpin_entry_locked(self, entry):
         for b in entry.get("pinned", ()):
@@ -512,8 +530,9 @@ class DirectCaller:
                 self._maybe_free_locked(ObjectID(b), ist)
         entry["pinned"] = ()
 
-    def _wake_deps_locked(self, entry: dict):
-        """Dependent specs waiting on this task's returns may now push."""
+    def _wake_deps_locked(self, entry: dict) -> List[tuple]:
+        """Dependent specs waiting on this task's returns may now push;
+        returns the scheduling classes to pump (after lock release)."""
         tid = TaskID(entry["tid_bin"])
         ready = []
         for i in range(entry["spec"]["num_returns"]):
@@ -522,11 +541,12 @@ class DirectCaller:
                 dep_entry["deps"] -= 1
                 if dep_entry["deps"] == 0:
                     ready.append(dep_entry)
+        klasses = set()
         for dep_entry in ready:
             klass = self._sched_class(dep_entry["spec"])
             self._pool_locked(klass)["queue"].append(dep_entry)
-            threading.Thread(target=self._pump, args=(klass,),
-                             daemon=True).start()
+            klasses.add(klass)
+        return list(klasses)
 
     def _on_lease_dead(self, lease: _Lease):
         """Executor died or conn broke: resubmit its inflight work
@@ -586,17 +606,23 @@ class DirectCaller:
                     st.descr = err_descr
                     self._maybe_free_locked(tid.object_id(i), st)
             self._unpin_entry_locked(entry)
-            self._wake_deps_locked(entry)
+            dep_klasses = self._wake_deps_locked(entry)
             self.cv.notify_all()
         if exported:
             try:
                 self.host.head_send(("export_complete", exported))
             except Exception:
                 pass
+        self._flush_outbound()
+        for klass in dep_klasses:
+            self._pump(klass)
 
     def _reroute_to_head(self, entry):
         """No leases: delegate this spec (and its owned returns) to the
-        head scheduler so progress is guaranteed."""
+        head scheduler so progress is guaranteed.  The entry's arg pins
+        are released only AFTER the head has the spec — the export in
+        submit_via_head must still see the args alive (a dropped-ref arg
+        would otherwise be freed before the head could pin it)."""
         spec = entry["spec"]
         tid = TaskID(entry["tid_bin"])
         with self.lock:
@@ -604,10 +630,11 @@ class DirectCaller:
                 st = self.owned.get(tid.object_id(i))
                 if st is not None:
                     st.status = DELEGATED
-            self._unpin_entry_locked(entry)
         self.host.submit_via_head(spec)
         with self.lock:
+            self._unpin_entry_locked(entry)
             self.cv.notify_all()
+        self._flush_outbound()
 
     def _ensure_linger_thread(self):
         if self._linger_thread is None or not self._linger_thread.is_alive():
@@ -778,6 +805,7 @@ class DirectCaller:
                     if ist is not None:
                         ist.pins -= 1
                         self._maybe_free_locked(ObjectID(b), ist)
+        self._flush_outbound()
 
     def shutdown(self):
         self._stopped = True

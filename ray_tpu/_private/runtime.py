@@ -2283,16 +2283,21 @@ class Runtime:
                     st.worker_refs += 1
                     if ok is None:
                         continue  # pending shell; export_complete follows
-                    st.status = READY if ok else ERRORED
-                    st.descr = descr
-                    if descr is not None and descr[0] == protocol.SHM:
-                        cw = (self._workers_by_hex.get(creator_hex)
-                              if creator_hex else worker)
-                        if cw is not None and not cw.dead:
-                            st.creator = cw
-                        st.shipped = True
                     st.nested_ids = list(nested)
                     self._pin_nested_locked(st.nested_ids)
+                    if descr is not None and descr[0] == protocol.SHM:
+                        st.shipped = True
+                    cw = (self._workers_by_hex.get(creator_hex)
+                          if creator_hex else worker)
+                    # _complete_object_locked (not a bare status write):
+                    # a consumer may ALREADY be blocked on this object —
+                    # e.g. it deserialized the ref from a direct task's
+                    # container arg before this export was processed —
+                    # and its mget waiter must fire.
+                    self._complete_object_locked(
+                        oid, descr, bool(ok),
+                        creator=(cw if cw is not None and not cw.dead
+                                 else None))
         elif tag == "export_complete":
             with self.lock:
                 for item in msg[1]:

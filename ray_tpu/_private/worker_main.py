@@ -577,11 +577,14 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
             result = fn(*args, **kwargs)
             if asyncio.iscoroutine(result):
                 result = _run_coroutine(result)
-        returns = _pack_returns(rt, task_id, result, num_returns)
+        returns, nested = _pack_returns(rt, task_id, result, num_returns)
         if dreply is not None:
             # Direct-pushed task: the reply goes straight to the owning
-            # caller on its connection, never through the head.
-            dreply[0].reply(dreply[1], True, returns, {})
+            # caller on its connection, never through the head.  Nested
+            # ref bins ride in meta so a pending-export shell completed
+            # at the head gets its nested pins.
+            meta = {"nested": nested} if any(nested) else {}
+            dreply[0].reply(dreply[1], True, returns, meta)
         else:
             rt.send_result((task["task_id"], True, returns, {}))
     except Exception as e:  # noqa: BLE001 — task errors become objects
@@ -630,28 +633,27 @@ def _pack_returns(rt: _WorkerRuntime, task_id: TaskID, result, num_returns):
                 f"{len(values)} values"
             )
     out = []
-    nested_all = []
+    nested_lists = []
     for i, v in enumerate(values):
         oid = task_id.object_id(i)
         rt.begin_ref_collection()
         try:
             out.append(rt.serialize_value(v, oid))
         finally:
-            nested_all.extend(rt.end_ref_collection())
+            nested_lists.append(rt.end_ref_collection())
         rt._cache_put(oid, v)
+    nested_all = [b for lst in nested_lists for b in lst]
     if nested_all:
         # Returned values embed ObjectRefs: any owned by THIS worker must
         # become head-visible before the consumer tries to use them
         # (simplified borrow protocol — the consumer's addref/get go to
         # the head).
-        from ray_tpu._private import direct as _dm
-
         owned = [b for b in nested_all
                  if rt.direct.status_of(ObjectID(b))
-                 not in (None, _dm.DELEGATED)]
+                 not in (None, direct_mod.DELEGATED)]
         if owned:
             rt.direct.export_refs(owned)
-    return out
+    return out, nested_lists
 
 
 _async_loop = None
