@@ -123,6 +123,12 @@ class DirectCaller:
         # after release (a peer's full TCP buffer must never stall the
         # whole ownership table).
         self._outbound: List[tuple] = []
+        # actor_id -> channel dict for direct actor calls (reference:
+        # direct_actor_task_submitter.h:67 — per-actor ordered pushes
+        # straight to the actor's worker).  state: new -> resolving ->
+        # direct | head ("head" is sticky: once any call routes through
+        # the head, later calls do too, preserving per-caller order).
+        self.actor_channels: Dict[bytes, dict] = {}
 
     # ------------------------------------------------------------- owned --
     def register_put(self, oid: ObjectID, descr, nested_local, nested_head):
@@ -274,42 +280,46 @@ class DirectCaller:
             if a[0] == "ref":
                 yield a[1]
 
+    def _register_entry_locked(self, spec: dict,
+                               retries: int) -> Tuple[dict, list]:
+        """Shared submit bookkeeping: owned return states, arg/nested
+        pins, and dep-waiter registration for pending owned args."""
+        tid = TaskID(spec["task_id"])
+        entry = {
+            "spec": spec, "rid": None, "retries": retries,
+            "deps": 0, "tid_bin": spec["task_id"], "pinned": (),
+        }
+        states = []
+        for i in range(spec["num_returns"]):
+            st = OwnedState(spec["task_id"])
+            st.local_refs = 1
+            self.owned[tid.object_id(i)] = st
+            states.append(st)
+        pinned = list(itertools.chain(self._iter_ref_args(spec),
+                                      spec.get("nested_refs", ())))
+        for b in pinned:
+            ist = self.owned.get(ObjectID(b))
+            if ist is not None:
+                ist.pins += 1
+        entry["pinned"] = pinned
+        for b in self._iter_ref_args(spec):
+            ist = self.owned.get(ObjectID(b))
+            if ist is not None and ist.status == PENDING:
+                entry["deps"] += 1
+                self._dep_waiters.setdefault(b, []).append(entry)
+        return entry, states
+
     def submit(self, spec: dict) -> List[OwnedState]:
         """Register owned returns + queue the spec for push.  Caller-side
         dependency resolution: the spec is held until every owned ref arg
         is READY (reference: the caller's LocalDependencyResolver,
         direct_task_transport.cc:33)."""
-        tid = TaskID(spec["task_id"])
         klass = self._sched_class(spec)
-        entry = {
-            "spec": spec, "rid": None,
-            "retries": spec.get("max_retries", 3),
-            "deps": 0, "tid_bin": spec["task_id"], "pinned": (),
-        }
         with self.lock:
-            states = []
-            for i in range(spec["num_returns"]):
-                st = OwnedState(spec["task_id"])
-                st.local_refs = 1
-                self.owned[tid.object_id(i)] = st
-                states.append(st)
-            # Pin ref args + nested refs for the task's lifetime.
-            for b in itertools.chain(self._iter_ref_args(spec),
-                                     spec.get("nested_refs", ())):
-                ist = self.owned.get(ObjectID(b))
-                if ist is not None:
-                    ist.pins += 1
-            entry["pinned"] = list(itertools.chain(
-                self._iter_ref_args(spec), spec.get("nested_refs", ())))
-            for b in self._iter_ref_args(spec):
-                ist = self.owned.get(ObjectID(b))
-                if ist is not None and ist.status == PENDING:
-                    entry["deps"] += 1
-                    ist_waiters = self._dep_waiters.setdefault(b, [])
-                    ist_waiters.append(entry)
-            pool = self._pool_locked(klass)
+            entry, states = self._register_entry_locked(
+                spec, spec.get("max_retries", 3))
             if entry["deps"] == 0:
-                pool["queue"].append(entry)
+                self._pool_locked(klass)["queue"].append(entry)
         if entry["deps"] == 0:
             self._pump(klass)
         return states
@@ -421,6 +431,172 @@ class DirectCaller:
             task["func_id"] = spec["func_id"]
         return task
 
+    # ------------------------------------------------------------ actors --
+    def submit_actor(self, spec: dict) -> Optional[List[OwnedState]]:
+        """Direct actor-call path.  Returns owned return states when the
+        call was queued on a direct channel, or None when the caller must
+        route through the head (unresolved/dead actor, foreign ref args,
+        sticky head mode).
+
+        Ordering: a channel that must fall back enters ``head_draining``
+        — queued-and-future calls are held until every already-pushed
+        call acks, then flush through the head in order.  This closes
+        the window where a head-routed call could overtake an inflight
+        direct push (the sequence-number guarantee of
+        direct_actor_task_submitter.h:67)."""
+        aid = spec["actor_id"]
+        # Export owned nested refs BEFORE the entry becomes pushable: a
+        # concurrent _pump_actor may push it the moment it is queued, and
+        # the executor resolves container refs through the head.
+        owned_nested = [b for b in spec.get("nested_refs", ())
+                        if self.status_of(ObjectID(b))
+                        not in (None, DELEGATED)]
+        if owned_nested:
+            self.export_refs(owned_nested)
+        with self.lock:
+            ch = self.actor_channels.get(aid)
+            if ch is None:
+                ch = self.actor_channels[aid] = {
+                    "state": "new", "lease": None, "queue": deque()}
+            if ch["state"] == "head":
+                return None
+            foreign_arg = False
+            for b in self._iter_ref_args(spec):
+                st = self.owned.get(ObjectID(b))
+                if st is None or (st.descr is None
+                                  and st.status == DELEGATED):
+                    foreign_arg = True
+                    break
+            if foreign_arg:
+                lease = ch["lease"]
+                if ch["state"] == "direct" and lease is not None \
+                        and lease.inflight:
+                    # Inflight direct pushes: drain before any head
+                    # routing (order).  This call joins the held queue
+                    # as a head-bound entry.
+                    ch["state"] = "head_draining"
+                    entry, states = self._register_entry_locked(spec, 0)
+                    entry["via_head"] = True
+                    ch["queue"].append(entry)
+                    return states
+                queued = list(ch["queue"])
+                ch["queue"].clear()
+                ch["state"] = "head"
+            else:
+                queued = None
+                entry, states = self._register_entry_locked(spec, 0)
+                if ch["state"] == "head_draining":
+                    entry["via_head"] = True
+                ch["queue"].append(entry)
+                if ch["state"] == "new":
+                    ch["state"] = "resolving"
+                    threading.Thread(target=self._resolve_actor,
+                                     args=(aid,), daemon=True).start()
+        if queued is not None:
+            for e in queued:
+                self._reroute_to_head(e)
+            return None
+        self._pump_actor(aid)
+        return states
+
+    def _resolve_actor(self, aid: bytes):
+        try:
+            reply = self.host.head_request(
+                lambda rid: ("actor_addr_req", rid, aid))
+        except Exception:
+            reply = None
+        lease = None
+        if reply:
+            wid, addr = reply
+            lease = _Lease(wid, addr, ("actor", aid))
+            try:
+                lease.conn = self.host.dial(addr)
+            except Exception:
+                lease = None
+        queued = None
+        with self.lock:
+            ch = self.actor_channels.get(aid)
+            if ch is None:
+                return
+            if lease is None:
+                queued = list(ch["queue"])
+                ch["queue"].clear()
+                ch["state"] = "head"
+            else:
+                ch["lease"] = lease
+                ch["state"] = "direct"
+        if queued is not None:
+            for e in queued:
+                self._reroute_to_head(e)
+            return
+        threading.Thread(target=self._lease_reader, args=(lease,),
+                         daemon=True).start()
+        self._pump_actor(aid)
+
+    def _pump_actor(self, aid: bytes):
+        """Strictly FIFO: the queue head pushes only once its deps are
+        READY — later entries wait behind it (per-caller ordering, the
+        sequence-number guarantee of direct_actor_task_submitter.h:67)."""
+        to_push, to_head = [], []
+        with self.lock:
+            ch = self.actor_channels.get(aid)
+            if ch is None:
+                return
+            if ch["state"] == "head_draining":
+                lease = ch["lease"]
+                if lease is None or not lease.inflight:
+                    # Every direct push acked: safe to flush the held
+                    # calls through the head in order.
+                    to_head = list(ch["queue"])
+                    ch["queue"].clear()
+                    ch["state"] = "head"
+                    ch["lease"] = None
+            elif ch["state"] == "direct":
+                lease = ch["lease"]
+                q = ch["queue"]
+                while q and q[0]["deps"] == 0:
+                    entry = q.popleft()
+                    rid = next(self.rid_counter)
+                    entry["rid"] = rid
+                    lease.inflight[rid] = entry
+                    to_push.append((lease, entry))
+        for entry in to_head:
+            self._reroute_to_head(entry)
+        for lease, entry in to_push:
+            self._push_one(lease, entry)
+
+    def _pump_any(self, klass):
+        if klass and klass[0] == "actor":
+            self._pump_actor(klass[1])
+        else:
+            self._pump(klass)
+
+    def _on_actor_channel_dead(self, lease: _Lease, aid: bytes):
+        """Actor worker conn broke: already-pushed calls may have run, so
+        they fail (ActorDiedError, the reference's default for actor
+        tasks); never-pushed queued calls reroute through the head, which
+        knows the actor's restart state authoritatively."""
+        with self.lock:
+            ch = self.actor_channels.get(aid)
+            inflight = list(lease.inflight.values())
+            lease.inflight.clear()
+            queued = []
+            if ch is not None and ch.get("lease") is lease:
+                queued = list(ch["queue"])
+                ch["queue"].clear()
+                ch["state"] = "head"
+                ch["lease"] = None
+        try:
+            if lease.conn is not None:
+                lease.conn.close()
+        except Exception:
+            pass
+        for entry in inflight:
+            self._fail_entry(entry, exc.ActorDiedError(
+                "Actor worker connection lost (direct channel)"))
+        for entry in queued:
+            self._reroute_to_head(entry)
+
     # ------------------------------------------------------------ leases --
     def _request_leases(self, klass, n):
         pool = None
@@ -507,6 +683,10 @@ class DirectCaller:
                 st.descr = descr
                 if descr[0] == protocol.SHM:
                     st.creator = lease
+                if i < len(nested) and nested[i]:
+                    # The executor addref'd these at the head for us;
+                    # our free decrefs them (borrowed-ref transfer).
+                    st.nested_head = list(nested[i])
                 self._maybe_free_locked(oid, st)
             self._unpin_entry_locked(entry)
             dep_klasses = self._wake_deps_locked(entry)
@@ -517,10 +697,10 @@ class DirectCaller:
             except Exception:
                 pass
         self._flush_outbound()
-        self._pump(lease.klass)
+        self._pump_any(lease.klass)
         for klass in dep_klasses:
             if klass != lease.klass:
-                self._pump(klass)
+                self._pump_any(klass)
 
     def _unpin_entry_locked(self, entry):
         for b in entry.get("pinned", ()):
@@ -543,15 +723,30 @@ class DirectCaller:
                     ready.append(dep_entry)
         klasses = set()
         for dep_entry in ready:
-            klass = self._sched_class(dep_entry["spec"])
-            self._pool_locked(klass)["queue"].append(dep_entry)
-            klasses.add(klass)
+            if dep_entry.get("rerouted"):
+                continue
+            spec = dep_entry["spec"]
+            if "actor_id" in spec:
+                # Actor entries never left their channel queue (FIFO);
+                # just pump the channel.
+                klasses.add(("actor", spec["actor_id"]))
+            else:
+                klass = self._sched_class(spec)
+                self._pool_locked(klass)["queue"].append(dep_entry)
+                klasses.add(klass)
         return list(klasses)
 
     def _on_lease_dead(self, lease: _Lease):
         """Executor died or conn broke: resubmit its inflight work
         (caller-side retries; reference: lease worker failure handling in
         direct_task_transport.cc)."""
+        if lease.klass and lease.klass[0] == "actor":
+            with self.lock:
+                if lease.dead:
+                    return
+                lease.dead = True
+            self._on_actor_channel_dead(lease, lease.klass[1])
+            return
         with self.lock:
             if lease.dead:
                 return
@@ -615,26 +810,58 @@ class DirectCaller:
                 pass
         self._flush_outbound()
         for klass in dep_klasses:
-            self._pump(klass)
+            self._pump_any(klass)
 
     def _reroute_to_head(self, entry):
         """No leases: delegate this spec (and its owned returns) to the
         head scheduler so progress is guaranteed.  The entry's arg pins
         are released only AFTER the head has the spec — the export in
         submit_via_head must still see the args alive (a dropped-ref arg
-        would otherwise be freed before the head could pin it)."""
+        would otherwise be freed before the head could pin it).
+
+        Dependents parked on this task's returns reroute too: no dresult
+        will ever arrive here to wake them, and the head resolves
+        delegated deps natively (their shells export with the specs)."""
         spec = entry["spec"]
         tid = TaskID(entry["tid_bin"])
+        dependents = []
+        actor_flips = []
         with self.lock:
+            if entry.get("rerouted"):
+                return
+            entry["rerouted"] = True
             for i in range(spec["num_returns"]):
                 st = self.owned.get(tid.object_id(i))
                 if st is not None:
                     st.status = DELEGATED
+                for dep_entry in self._dep_waiters.pop(
+                        tid.object_id(i).binary(), []) or []:
+                    dep_entry["deps"] -= 1
+                    if dep_entry.get("rerouted"):
+                        continue
+                    dspec = dep_entry["spec"]
+                    if "actor_id" in dspec:
+                        # Actor entries stay in their channel queue; the
+                        # channel must go head-mode (order-preserving
+                        # drain) since this dep resolves at the head.
+                        actor_flips.append(dspec["actor_id"])
+                        dep_entry["via_head"] = True
+                    else:
+                        dependents.append(dep_entry)
         self.host.submit_via_head(spec)
         with self.lock:
             self._unpin_entry_locked(entry)
+            for aid in actor_flips:
+                ch = self.actor_channels.get(aid)
+                if ch is not None and ch["state"] in ("direct",
+                                                      "resolving", "new"):
+                    ch["state"] = "head_draining"
             self.cv.notify_all()
         self._flush_outbound()
+        for dep_entry in dependents:
+            self._reroute_to_head(dep_entry)
+        for aid in set(actor_flips):
+            self._pump_actor(aid)
 
     def _ensure_linger_thread(self):
         if self._linger_thread is None or not self._linger_thread.is_alive():

@@ -57,7 +57,7 @@ class ObjectState:
     __slots__ = (
         "status", "descr", "local_refs", "worker_refs", "pins",
         "futures", "waiters", "task_id", "value", "has_value", "segment",
-        "nested_ids", "shipped", "creator",
+        "nested_ids", "shipped", "creator", "exporter",
     )
 
     def __init__(self, task_id: Optional[TaskID] = None):
@@ -83,6 +83,10 @@ class ObjectState:
         # ObjectIDs (binary) of refs pickled inside this object's value;
         # pinned until this object is freed.
         self.nested_ids: List[bytes] = []
+        # WorkerHandle that exported this entry as a PENDING shell and
+        # owes an export_complete; its death fails the object (owner
+        # death semantics, reference: OwnerDiedError).
+        self.exporter = None
 
     def refcount(self):
         return self.local_refs + self.worker_refs + self.pins
@@ -1581,9 +1585,13 @@ class Runtime:
             return
 
         def finish():
+            # One shared deadline across the batch (not 15s each): a
+            # stuck spawn must not serialize into minutes of stall.
+            deadline = time.monotonic() + 15.0
             out, failed = [], []
             for w in granted:
-                if (w.ready.wait(timeout=30.0) and w.direct_addr
+                left = max(0.0, deadline - time.monotonic())
+                if (w.ready.wait(timeout=left) and w.direct_addr
                         and not w.dead):
                     out.append((w.worker_id.hex(), tuple(w.direct_addr)))
                 else:
@@ -1594,6 +1602,7 @@ class Runtime:
                         w.client_lease = None
                         if not w.dead:
                             self._end_lease_locked(w)
+                    self._dispatch_locked()
             worker_send_safe(lessee, ("reply", rid, out))
 
         threading.Thread(target=finish, daemon=True,
@@ -2091,7 +2100,11 @@ class Runtime:
                         count["ready"] += 1
                     else:
                         pend.append(st)
-                if count["ready"] >= num_returns or not pend:
+                if count["ready"] >= num_returns or not pend \
+                        or timeout == 0:
+                    # timeout == 0 is a PROBE (the mixed-ownership wait
+                    # poll): answer immediately, register nothing — no
+                    # leaked waiter callbacks or Timer threads per poll.
                     count["sent"] = True
                 else:
                     # The wait really blocks this worker: steal back its
@@ -2253,6 +2266,28 @@ class Runtime:
                     if st is None:
                         st = self.objects[oid] = ObjectState()
                     st.worker_refs += 1
+        elif tag == "actor_addr_req":
+            # Resolve an actor to its worker's direct endpoint so the
+            # caller can push method calls straight to it (reference:
+            # direct_actor_task_submitter resolving the actor's address
+            # via the GCS actor table).
+            _, rid, aid = msg
+            with self.lock:
+                actor = self.actors.get(aid)
+            if actor is None:
+                worker_send_safe(worker, ("reply", rid, None))
+            else:
+                def on_created(_fut, aid=aid, rid=rid, lessee=worker):
+                    with self.lock:
+                        a = self.actors.get(aid)
+                        w = (a.worker if a is not None and a.status == ALIVE
+                             else None)
+                        out = ((w.worker_id.hex(), tuple(w.direct_addr))
+                               if w is not None and not w.dead
+                               and w.direct_addr else None)
+                    worker_send_safe(lessee, ("reply", rid, out))
+
+                actor.created_future.add_done_callback(on_created)
         elif tag == "lease_req":
             # A caller wants executor workers to push tasks to directly;
             # the head only does the resource accounting (reference: the
@@ -2282,7 +2317,10 @@ class Runtime:
                         st = self.objects[oid] = ObjectState()
                     st.worker_refs += 1
                     if ok is None:
-                        continue  # pending shell; export_complete follows
+                        # Pending shell; export_complete follows — unless
+                        # the exporter dies first (death path fails it).
+                        st.exporter = worker
+                        continue
                     st.nested_ids = list(nested)
                     self._pin_nested_locked(st.nested_ids)
                     if descr is not None and descr[0] == protocol.SHM:
@@ -2314,6 +2352,8 @@ class Runtime:
                         st.shipped = True
                     cw = (self._workers_by_hex.get(creator_hex)
                           if creator_hex else None)
+                    if st is not None:
+                        st.exporter = None
                     self._complete_object_locked(oid, descr, bool(ok),
                                                  creator=cw)
         elif tag == "free_remote":
@@ -2588,6 +2628,18 @@ class Runtime:
                         if not w.dead:
                             self._end_lease_locked(w)
             worker.client_lease = None
+            # Pending-export shells this worker owed a completion for:
+            # the owner is gone, fail them (owner-death semantics).
+            err = None
+            for oid, st in list(self.objects.items()):
+                if st.exporter is worker and st.status == PENDING:
+                    if err is None:
+                        err = (protocol.ERROR, serialization.dumps_inline(
+                            exc.ObjectLostError(
+                                "Owner worker died before completing "
+                                "its exported object")))
+                    st.exporter = None
+                    self._complete_object_locked(oid, err, False)
             if worker.actor_id is not None:
                 self._on_actor_worker_death(worker)
                 return

@@ -448,6 +448,15 @@ class _WorkerRuntime:
         if spec.get("func_payload") is not None:
             self._fn_payloads.setdefault(spec["func_id"],
                                          spec["func_payload"])
+        if "actor_id" in spec:
+            states = self.direct.submit_actor(spec)
+            if states is not None:
+                return [ObjectRef(tid.object_id(i), _register=False)
+                        for i in range(spec["num_returns"])]
+            self._export_for_head_path(spec)
+            self._send(("submit", 0, spec))
+            return [ObjectRef(tid.object_id(i), _register=False)
+                    for i in range(spec["num_returns"])]
         if self.direct.eligible(spec):
             owned_nested = [
                 b for b in spec.get("nested_refs", ())
@@ -495,7 +504,9 @@ class _WorkerRuntime:
                                      [r.id().binary() for r in refs],
                                      num_returns, left)))
                     break
-                # Mixed ownership: poll both authorities (rare path).
+                # Mixed ownership: probe the head (timeout=0 answers
+                # immediately, registers nothing) and pace on the local
+                # condition variable — no per-poll head state.
                 ready, _delegated = self.direct.wait_owned_n(
                     [r.id() for r in owned], num_returns, 0)
                 ready_bin = set(ready)
@@ -503,13 +514,14 @@ class _WorkerRuntime:
                     ready_bin.update(self._request(
                         lambda rid: ("wait", rid,
                                      [r.id().binary() for r in foreign],
-                                     num_returns - len(ready_bin), 0.05)))
+                                     num_returns - len(ready_bin), 0)))
                 if len(ready_bin) >= num_returns:
                     break
                 if deadline is not None and \
                         _time.monotonic() >= deadline:
                     break
-                _time.sleep(0.005)
+                with self.direct.cv:
+                    self.direct.cv.wait(0.05)
         finally:
             self._send(("unblocked", tid.binary() if tid else b""))
         ready = [r for r in refs if r.id().binary() in ready_bin]
@@ -581,9 +593,15 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
         if dreply is not None:
             # Direct-pushed task: the reply goes straight to the owning
             # caller on its connection, never through the head.  Nested
-            # ref bins ride in meta so a pending-export shell completed
-            # at the head gets its nested pins.
-            meta = {"nested": nested} if any(nested) else {}
+            # ref bins ride in meta; this worker addrefs them at the head
+            # ON THE CALLER'S BEHALF (the caller's owned entry decrefs on
+            # free) so an LRU eviction here cannot free a returned ref
+            # before the caller materializes it.
+            meta = {}
+            if any(nested):
+                rt._send(("addref_batch",
+                          [b for lst in nested for b in lst]))
+                meta = {"nested": nested}
             dreply[0].reply(dreply[1], True, returns, meta)
         else:
             rt.send_result((task["task_id"], True, returns, {}))

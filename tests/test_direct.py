@@ -206,3 +206,88 @@ def test_executor_death_resubmit(rt):
 
     k = Killer.remote()
     assert ray.get(k.run.remote(), timeout=60) == "survived"
+
+
+@ray.remote
+class _Target:
+    def __init__(self):
+        self.n = 0
+
+    def m(self):
+        self.n += 1
+        return self.n
+
+    def get_n(self):
+        return self.n
+
+
+def test_direct_actor_calls(rt):
+    @ray.remote
+    class Caller:
+        def run(self, target, n):
+            import ray_tpu as ray
+
+            return ray.get([target.m.remote() for _ in range(n)])[-1]
+
+    t = _Target.remote()
+    callers = [Caller.remote() for _ in range(3)]
+    res = ray.get([c.run.remote(t, 25) for c in callers])
+    assert sorted(res)[-1] == 75
+    assert ray.get(t.get_n.remote()) == 75
+
+
+def test_direct_actor_ordering(rt):
+    @ray.remote
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def add(self, x):
+            self.log.append(x)
+
+        def get_log(self):
+            return self.log
+
+    @ray.remote
+    class Caller:
+        def run(self, s):
+            import ray_tpu as ray
+
+            for i in range(30):
+                s.add.remote(i)
+            # The final get rides the same FIFO channel: it observes
+            # every prior call.
+            return ray.get(s.get_log.remote())
+
+    s = Seq.remote()
+    assert ray.get(Caller.remote().run.remote(s)) == list(range(30))
+
+
+def test_direct_actor_death(rt):
+    @ray.remote
+    class Fragile:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ok(self):
+            return 1
+
+    @ray.remote
+    class Caller:
+        def run(self, f):
+            import ray_tpu as ray
+
+            assert ray.get(f.ok.remote()) == 1
+            f.die.remote()
+            try:
+                ray.get(f.ok.remote(), timeout=30)
+                return "alive"
+            except ray.exceptions.RayActorError:
+                return "died"
+            except ray.exceptions.RayTpuError:
+                return "died"
+
+    f = Fragile.remote()
+    assert ray.get(Caller.remote().run.remote(f), timeout=60) == "died"
