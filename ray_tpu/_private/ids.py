@@ -117,9 +117,11 @@ class ObjectID(BaseID):
 
     @classmethod
     def for_put(cls) -> "ObjectID":
-        # Puts get a random prefix with index 0xFFFFFFFF to distinguish from
-        # task returns (reference uses a dedicated put-index space).
-        return cls(os.urandom(_TASK_PREFIX_SIZE) + b"\xff\xff\xff\xff")
+        # Puts share the seed+counter prefix space with index 0xFFFFFFFF
+        # to distinguish from task returns (whose index is a small int).
+        n = _task_counter.next()
+        return cls(_PROC_SEED + (n & 0xFFFFFFFF).to_bytes(4, "little")
+                   + b"\xff\xff\xff\xff")
 
     def is_put(self) -> bool:
         return self._bytes[_TASK_PREFIX_SIZE:] == b"\xff\xff\xff\xff"
@@ -138,13 +140,15 @@ class _Counter:
 
 _task_counter = _Counter()
 
+# One entropy draw per process; ids are seed + counter (reference: task
+# ids are deterministic child ids, id.h:175 — and os.urandom per id was
+# ~40us, a measurable slice of the per-task submit budget).
+_PROC_SEED = os.urandom(_TASK_PREFIX_SIZE - 4)
+
 
 def new_task_id() -> TaskID:
-    """Random task ID.  Monotonic counter mixed in to make collisions
-    impossible within a process even with a weak entropy pool."""
+    """Process-unique task ID: 8-byte process seed + 4-byte counter
+    prefix (collision across processes needs a seed collision)."""
     n = _task_counter.next()
-    raw = bytearray(os.urandom(_ID_SIZE))
-    raw[_TASK_PREFIX_SIZE - 4 : _TASK_PREFIX_SIZE] = (n & 0xFFFFFFFF).to_bytes(
-        4, "little"
-    )
-    return TaskID(bytes(raw))
+    return TaskID(_PROC_SEED + (n & 0xFFFFFFFF).to_bytes(4, "little")
+                  + b"\x00\x00\x00\x00")

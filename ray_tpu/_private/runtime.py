@@ -161,7 +161,7 @@ class WorkerHandle:
     __slots__ = (
         "worker_id", "conn", "proc", "node", "send_lock", "env_key",
         "inflight", "actor_id", "tpu_chips", "idle_since", "released",
-        "ready", "dead", "outbox", "spawned_at",
+        "ready", "dead", "outbox", "outbuf", "spawned_at",
         "lease_key", "lease_req", "lease_pg", "blocked",
         "pending_force_kill", "direct_addr", "client_lease",
     )
@@ -185,6 +185,7 @@ class WorkerHandle:
         self.ready = threading.Event()
         self.dead = False
         self.outbox: List[tuple] = []
+        self.outbuf: List[tuple] = []  # conflation-sender batch buffer
         self.spawned_at = time.monotonic()
         # Lease state: while leased, the worker holds lease_req resources on
         # its node (or lease_pg's bundle) and serves one scheduling class.
@@ -207,6 +208,25 @@ class WorkerHandle:
                 self.outbox.append(msg)
             else:
                 protocol.send(self.conn, msg)
+
+    def queue_msg(self, msg):
+        """Buffer a task-path message for the conflation sender: while
+        one flush's pickle+write syscall runs, later dispatches pile into
+        the next batch — self-clocking batching with no added latency
+        floor (reference: gRPC stream write coalescing)."""
+        with self.send_lock:
+            self.outbuf.append(msg)
+
+    def flush_buffered(self):
+        with self.send_lock:
+            if not self.outbuf:
+                return
+            msgs, self.outbuf = self.outbuf, []
+            payload = msgs[0] if len(msgs) == 1 else ("msg_batch", msgs)
+            if self.conn is None:
+                self.outbox.append(payload)
+            else:
+                protocol.send(self.conn, payload)
 
     def attach(self, conn):
         with self.send_lock:
@@ -439,7 +459,30 @@ class Runtime:
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True, name="ray_tpu-reaper")
         self._reaper.start()
+        # Conflation sender: dispatches buffer exec/func messages per
+        # worker; this thread flushes them as msg_batch frames.  While
+        # one flush's pickle+write runs, later dispatches coalesce into
+        # the next batch — a burst of .remote() calls costs ~1 syscall
+        # per batch instead of one per task.
+        self._sender_event = threading.Event()
+        self._sender = threading.Thread(
+            target=self._task_sender_loop, daemon=True,
+            name="ray_tpu-sender")
+        self._sender.start()
         atexit.register(self.shutdown)
+
+    def _task_sender_loop(self):
+        while not self._stopped:
+            self._sender_event.wait()
+            self._sender_event.clear()
+            with self.lock:
+                dirty = [w for n in self.nodes.values()
+                         for w in n.all_workers.values() if w.outbuf]
+            for w in dirty:
+                try:
+                    w.flush_buffered()
+                except Exception:
+                    self._on_worker_death(w)
 
     # ------------------------------------------------------------- nodes --
     def _add_node_locked(self, resources, labels=None, agent=None,
@@ -1550,8 +1593,11 @@ class Runtime:
             agent.node = node
             self._agents[agent.store_id] = agent
             self._conn_to_agent[conn] = agent
-        protocol.send(conn, ("agent_ack", node.node_id.hex(),
-                             self.session_id))
+            # Ack INSIDE the lock: the moment the node is registered, any
+            # thread holding the lock may dispatch a spawn_worker to this
+            # agent — the ack must be first on the wire (the agent's
+            # handshake asserts it).
+            agent.send(("agent_ack", node.node_id.hex(), self.session_id))
         threading.Thread(target=self._agent_reader, args=(conn, agent),
                          daemon=True, name="ray_tpu-rx-agent").start()
         with self.lock:
@@ -1653,11 +1699,11 @@ class Runtime:
         sent = self.worker_funcs.setdefault(fileno, set())
         func_id = spec.get("func_id")
         if func_id and func_id not in sent:
-            worker.send(("func", func_id, self.functions[func_id]))
+            worker.queue_msg(("func", func_id, self.functions[func_id]))
             sent.add(func_id)
         if rec.is_actor_creation:
             actor = self.actors[rec.actor_id]
-            worker.send(("create_actor", {
+            worker.queue_msg(("create_actor", {
                 "task_id": spec["task_id"],
                 "actor_id": rec.actor_id,
                 "func_id": func_id,
@@ -1668,7 +1714,8 @@ class Runtime:
                 "max_concurrency": actor.max_concurrency,
             }))
         else:
-            worker.send(("exec", msg_task))
+            worker.queue_msg(("exec", msg_task))
+        self._sender_event.set()
         self.task_events.append(
             {"task_id": spec["task_id"].hex(), "name": spec.get("name"),
              "state": "RUNNING", "time": time.time()})
@@ -2115,7 +2162,8 @@ class Runtime:
                                  if not r.is_actor_creation]
                     if stealable:
                         try:
-                            worker.send(("steal", 0, stealable))
+                            worker.queue_msg(("steal", 0, stealable))
+                            self._sender_event.set()
                         except Exception:
                             pass
                     def cb(_oid):
@@ -2519,7 +2567,8 @@ class Runtime:
                          if not r.is_actor_creation]
             if stealable:
                 try:
-                    worker.send(("steal", 0, stealable))
+                    worker.queue_msg(("steal", 0, stealable))
+                    self._sender_event.set()
                 except Exception:
                     pass
             state["left"] = len(pend)
@@ -2826,7 +2875,8 @@ class Runtime:
                     # otherwise burn retries or die as WorkerCrashedError).
                     w.pending_force_kill = rec.spec["task_id"]
                     try:
-                        w.send(("steal", 0, list(w.inflight.keys())))
+                        w.queue_msg(("steal", 0, list(w.inflight.keys())))
+                        self._sender_event.set()
                     except Exception:
                         try:
                             w.proc.terminate()
@@ -2853,7 +2903,8 @@ class Runtime:
                 # and fails it.  Already-started tasks are uncancellable
                 # without force (reference semantics).
                 try:
-                    rec.worker.send(("steal", 0, [rec.spec["task_id"]]))
+                    rec.worker.queue_msg(("steal", 0, [rec.spec["task_id"]]))
+                    self._sender_event.set()
                 except Exception:
                     pass
 
@@ -2862,6 +2913,7 @@ class Runtime:
         if self._stopped:
             return
         self._stopped = True
+        self._sender_event.set()  # unblock the conflation sender's exit
         with self.lock:
             workers = [w for n in self.nodes.values()
                        for w in list(n.all_workers.values())]

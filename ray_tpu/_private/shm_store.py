@@ -32,6 +32,44 @@ from ray_tpu._private.ids import ObjectID
 _MAGIC = b"RTPUOBJ1"
 _HEADER = struct.Struct("<8sQ")  # magic, meta_len
 
+# Large-buffer writes fan out across threads: numpy's copy releases the
+# GIL, so a single put saturates memory bandwidth instead of one core's
+# memcpy (the plasma store's parallel memcopy, store.cc memcopy_threads).
+_PARALLEL_COPY_MIN = 64 << 20
+_COPY_THREADS = 4
+_copy_pool = None
+_copy_pool_lock = threading.Lock()
+
+
+def _parallel_copy(mm: mmap.mmap, off: int, buf) -> None:
+    global _copy_pool
+    import numpy as np
+
+    n = len(buf)
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        mm[off : off + n] = buf
+        return
+    if _copy_pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with _copy_pool_lock:
+            if _copy_pool is None:
+                _copy_pool = ThreadPoolExecutor(
+                    max_workers=_COPY_THREADS,
+                    thread_name_prefix="rtpu-memcpy")
+    dst = np.frombuffer(mm, dtype=np.uint8, count=n, offset=off)
+    src = np.frombuffer(memoryview(buf).cast("B"), dtype=np.uint8)
+    threads = min(_COPY_THREADS, cores)
+    step = (n + threads - 1) // threads
+    futs = [
+        _copy_pool.submit(np.copyto, dst[i : i + step], src[i : i + step])
+        for i in range(0, n, step)
+    ]
+    for f in futs:
+        f.result()
+    del dst
+
 
 def _segment_path(shm_dir: str, name: str) -> str:
     return os.path.join(shm_dir, name)
@@ -134,7 +172,10 @@ class ShmStore:
         _HEADER.pack_into(mm, 0, _MAGIC, len(table))
         mm[_HEADER.size : _HEADER.size + len(table)] = table
         for off, buf in zip(offsets, buffers):
-            mm[off : off + len(buf)] = buf
+            if len(buf) >= _PARALLEL_COPY_MIN:
+                _parallel_copy(mm, off, buf)
+            else:
+                mm[off : off + len(buf)] = buf
         if self._pool_limit:
             # Keep the mapping open so a future reuse writes through
             # already-faulted pages; released in unlink()/cleanup().

@@ -800,37 +800,45 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
             tasks.extend(kept)
         rt._send(("stolen", steal_id, stolen))
 
+    def handle(msg):
+        tag = msg[0]
+        if tag in ("exec", "create_actor", "kill"):
+            with tq_cv:
+                tasks.append(msg)
+                tq_cv.notify()
+        elif tag == "msg_batch":
+            # Conflation-sender frame: a burst of buffered task-path
+            # messages in dispatch order.
+            for m in msg[1]:
+                handle(m)
+        elif tag == "steal":
+            steal(msg[1], set(msg[2]))
+        elif tag == "func":
+            fns.put(msg[1], msg[2])
+        elif tag == "obj":
+            rt.deliver_reply(msg[1], (msg[2], msg[3]))
+        elif tag == "mgot":
+            rt.deliver_reply(msg[1], msg[2])
+        elif tag == "waited":
+            rt.deliver_reply(msg[1], msg[2])
+        elif tag == "reply":
+            rt.deliver_reply(msg[1], msg[2])
+        elif tag == "free_segment":
+            # The owner freed an object whose segment this worker
+            # created; pool the pages for in-place reuse when no other
+            # process ever mapped them (reference: plasma arena reuse).
+            try:
+                rt.shm.unlink(msg[1], msg[2], reusable=msg[3])
+            except Exception:
+                pass
+
     def reader():
         while True:
             try:
                 msg = protocol.recv(conn)
             except (EOFError, OSError, TypeError):
                 os._exit(0)
-            tag = msg[0]
-            if tag in ("exec", "create_actor", "kill"):
-                with tq_cv:
-                    tasks.append(msg)
-                    tq_cv.notify()
-            elif tag == "steal":
-                steal(msg[1], set(msg[2]))
-            elif tag == "func":
-                fns.put(msg[1], msg[2])
-            elif tag == "obj":
-                rt.deliver_reply(msg[1], (msg[2], msg[3]))
-            elif tag == "mgot":
-                rt.deliver_reply(msg[1], msg[2])
-            elif tag == "waited":
-                rt.deliver_reply(msg[1], msg[2])
-            elif tag == "reply":
-                rt.deliver_reply(msg[1], msg[2])
-            elif tag == "free_segment":
-                # The owner freed an object whose segment this worker
-                # created; pool the pages for in-place reuse when no other
-                # process ever mapped them (reference: plasma arena reuse).
-                try:
-                    rt.shm.unlink(msg[1], msg[2], reusable=msg[3])
-                except Exception:
-                    pass
+            handle(msg)
 
     def _queue_empty():
         with tq_cv:
