@@ -289,6 +289,16 @@ class DirectCaller:
             "spec": spec, "rid": None, "retries": retries,
             "deps": 0, "tid_bin": spec["task_id"], "pinned": (),
         }
+        # Head-owned refs nested in container args get +1 at the head for
+        # the task's lifetime (the head path pins nested_refs in
+        # submit_task_from_worker; without this the caller's own decref
+        # could free them before the executor deserializes the arg).
+        foreign_nested = [b for b in spec.get("nested_refs", ())
+                          if self.owned.get(ObjectID(b)) is None]
+        if foreign_nested:
+            entry["foreign_nested"] = foreign_nested
+            self._outbound.append(("head", ("addref_batch",
+                                            foreign_nested)))
         states = []
         for i in range(spec["num_returns"]):
             st = OwnedState(spec["task_id"])
@@ -320,6 +330,10 @@ class DirectCaller:
                 spec, spec.get("max_retries", 3))
             if entry["deps"] == 0:
                 self._pool_locked(klass)["queue"].append(entry)
+        # Flush BEFORE returning to user code: the foreign-nested addref
+        # must be on the wire before the user can drop their own ref
+        # (whose buffered decref rides a later send on the same conn).
+        self._flush_outbound()
         if entry["deps"] == 0:
             self._pump(klass)
         return states
@@ -496,6 +510,7 @@ class DirectCaller:
             for e in queued:
                 self._reroute_to_head(e)
             return None
+        self._flush_outbound()
         self._pump_actor(aid)
         return states
 
@@ -684,9 +699,19 @@ class DirectCaller:
                 if descr[0] == protocol.SHM:
                     st.creator = lease
                 if i < len(nested) and nested[i]:
-                    # The executor addref'd these at the head for us;
-                    # our free decrefs them (borrowed-ref transfer).
-                    st.nested_head = list(nested[i])
+                    # The executor addref'd these at the head for us
+                    # (borrowed-ref transfer).  Bins WE own pin locally
+                    # instead — the head shell the executor's addref
+                    # created doesn't protect our local entry — and the
+                    # on-behalf head ref is returned immediately.
+                    for b in nested[i]:
+                        ist = self.owned.get(ObjectID(b))
+                        if ist is not None and ist.status != DELEGATED:
+                            ist.pins += 1
+                            st.nested_local.append(b)
+                            self._outbound.append(("head", ("decref", b)))
+                        else:
+                            st.nested_head.append(b)
                 self._maybe_free_locked(oid, st)
             self._unpin_entry_locked(entry)
             dep_klasses = self._wake_deps_locked(entry)
@@ -709,6 +734,9 @@ class DirectCaller:
                 ist.pins -= 1
                 self._maybe_free_locked(ObjectID(b), ist)
         entry["pinned"] = ()
+        fn = entry.pop("foreign_nested", None)
+        if fn:
+            self._outbound.append(("head", ("decref_batch", fn)))
 
     def _wake_deps_locked(self, entry: dict) -> List[tuple]:
         """Dependent specs waiting on this task's returns may now push;
@@ -864,11 +892,15 @@ class DirectCaller:
             self._pump_actor(aid)
 
     def _ensure_linger_thread(self):
-        if self._linger_thread is None or not self._linger_thread.is_alive():
-            self._linger_thread = threading.Thread(
-                target=self._linger_loop, daemon=True,
-                name="ray_tpu-lease-linger")
-            self._linger_thread.start()
+        # The linger loop clears _linger_thread under self.lock in the
+        # same critical section where it confirms no leases remain, so
+        # this check can't race a thread that is about to exit.
+        with self.lock:
+            if self._linger_thread is None:
+                self._linger_thread = threading.Thread(
+                    target=self._linger_loop, daemon=True,
+                    name="ray_tpu-lease-linger")
+                self._linger_thread.start()
 
     def _linger_loop(self):
         """Return idle leases to the head after LEASE_LINGER_S."""
@@ -904,7 +936,16 @@ class DirectCaller:
                 except Exception:
                     pass
             if not any_leases and not to_return:
-                return  # nothing leased anywhere; thread respawns on grant
+                # Exit decision under the SAME lock acquisition that saw
+                # zero leases — a concurrent grant either sees the thread
+                # cleared (and respawns it) or appended its lease before
+                # this scan (and the loop continues).
+                with self.lock:
+                    still_empty = not any(
+                        p["leases"] for p in self.pools.values())
+                    if still_empty:
+                        self._linger_thread = None
+                        return
 
     # --------------------------------------------------------------- get --
     def split_refs(self, refs):

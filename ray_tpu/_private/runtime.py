@@ -465,6 +465,7 @@ class Runtime:
         # the next batch — a burst of .remote() calls costs ~1 syscall
         # per batch instead of one per task.
         self._sender_event = threading.Event()
+        self._dirty_workers: set = set()
         self._sender = threading.Thread(
             target=self._task_sender_loop, daemon=True,
             name="ray_tpu-sender")
@@ -476,8 +477,7 @@ class Runtime:
             self._sender_event.wait()
             self._sender_event.clear()
             with self.lock:
-                dirty = [w for n in self.nodes.values()
-                         for w in n.all_workers.values() if w.outbuf]
+                dirty, self._dirty_workers = self._dirty_workers, set()
             for w in dirty:
                 try:
                     w.flush_buffered()
@@ -1700,6 +1700,7 @@ class Runtime:
         func_id = spec.get("func_id")
         if func_id and func_id not in sent:
             worker.queue_msg(("func", func_id, self.functions[func_id]))
+            self._dirty_workers.add(worker)
             sent.add(func_id)
         if rec.is_actor_creation:
             actor = self.actors[rec.actor_id]
@@ -1715,6 +1716,7 @@ class Runtime:
             }))
         else:
             worker.queue_msg(("exec", msg_task))
+        self._dirty_workers.add(worker)
         self._sender_event.set()
         self.task_events.append(
             {"task_id": spec["task_id"].hex(), "name": spec.get("name"),
@@ -2163,6 +2165,7 @@ class Runtime:
                     if stealable:
                         try:
                             worker.queue_msg(("steal", 0, stealable))
+                            self._dirty_workers.add(worker)
                             self._sender_event.set()
                         except Exception:
                             pass
@@ -2568,6 +2571,7 @@ class Runtime:
             if stealable:
                 try:
                     worker.queue_msg(("steal", 0, stealable))
+                    self._dirty_workers.add(worker)
                     self._sender_event.set()
                 except Exception:
                     pass
@@ -2876,6 +2880,7 @@ class Runtime:
                     w.pending_force_kill = rec.spec["task_id"]
                     try:
                         w.queue_msg(("steal", 0, list(w.inflight.keys())))
+                        self._dirty_workers.add(w)
                         self._sender_event.set()
                     except Exception:
                         try:
@@ -2904,6 +2909,7 @@ class Runtime:
                 # without force (reference semantics).
                 try:
                     rec.worker.queue_msg(("steal", 0, [rec.spec["task_id"]]))
+                    self._dirty_workers.add(rec.worker)
                     self._sender_event.set()
                 except Exception:
                     pass

@@ -339,6 +339,9 @@ class _WorkerRuntime:
                 missing.append((i, oid))
         if not owned and not missing:
             return values
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         tid = self.current_task_id
         self._send(("blocked", tid.binary() if tid else b""))
         try:
@@ -363,10 +366,12 @@ class _WorkerRuntime:
                         st.attached = True
                     self._cache_put(oid, values[i])
             if missing:
+                left = (None if deadline is None
+                        else max(0.0, deadline - _time.monotonic()))
                 reply = self._request(
                     lambda rid: ("mget", rid,
                                  [oid.binary() for _, oid in missing],
-                                 timeout))
+                                 left))
                 for (i, _oid), (ok, descr) in zip(missing, reply):
                     if not ok:
                         raise self.materialize_error(descr)
