@@ -96,6 +96,24 @@ class Config:
     # in here).  Use "0.0.0.0" for real multi-host clusters.
     listen_host: str = "127.0.0.1"
 
+    # --- GCS-analog fault tolerance (reference: GCS table persistence via
+    # redis, src/ray/gcs/store_client/redis_store_client.h:28, and the
+    # GcsInitData load-on-restart path, gcs_server.h:77). ---
+    # Snapshot file for head metadata (KV, functions, named actors, jobs).
+    # "" disables snapshotting.
+    gcs_snapshot_path: str = ""
+    # Snapshot cadence; dirty state is written at most this often.
+    gcs_snapshot_interval_s: float = 2.0
+    # Load the snapshot at init (head restart): restores KV/functions and
+    # re-creates named actors per their creation specs.
+    gcs_restore: bool = False
+    # Fixed TCP listener port (0 = ephemeral).  A restarting head must
+    # rebind the old port so agents and clients can re-dial it.
+    listen_port: int = 0
+    # Fixed cluster authkey (hex; "" = random per session).  Needed across
+    # head restarts so agents/clients can re-authenticate.
+    authkey_hex: str = ""
+
     @classmethod
     def from_env(cls, overrides: dict | None = None) -> "Config":
         kwargs = {}

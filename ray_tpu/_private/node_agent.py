@@ -124,7 +124,15 @@ class NodeAgent:
             try:
                 msg = protocol.recv(self.conn)
             except (EOFError, OSError):
-                break  # head is gone: shut down the node
+                # Head gone.  If it persists GCS state it may restart on
+                # the same port: kill our (orphaned) workers and re-dial
+                # for a grace period before giving the node up
+                # (reference: workers reconnecting across GCS restart,
+                # gcs_failover_worker_reconnect_timeout,
+                # ray_config_def.h:62).
+                if not self._reconnect():
+                    break
+                continue
             tag = msg[0]
             if tag == "spawn_worker":
                 self._spawn_worker(msg[1], msg[2])
@@ -140,6 +148,39 @@ class NodeAgent:
             elif tag == "shutdown":
                 break
         self.shutdown()
+
+    def _reconnect(self) -> bool:
+        if os.environ.get("RAY_TPU_AGENT_RECONNECT", "1") != "1":
+            return False
+        # The old session's workers hold dead head conns and stale
+        # state.  terminate -> wait -> kill, as in shutdown(): a TPU
+        # worker mid-computation takes seconds to die, and new workers
+        # must not race it for the chips.
+        for proc in self.workers.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        deadline = time.time() + 3.0
+        for proc in self.workers.values():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        self.workers.clear()
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        self.conn = None  # connect()'s retry-exhaustion guard needs this
+        try:
+            self.connect()  # its internal retry loop is the grace window
+            return True
+        except (SystemExit, Exception):
+            return False
 
     def _spawn_worker(self, worker_id_hex: str, env_overrides: Dict[str, str]):
         env = dict(os.environ)

@@ -424,7 +424,8 @@ class Runtime:
         # connects back over the raylet socket, services.py:1346).
         self._sock_dir = f"/tmp/ray_tpu_{self.session_id}"
         os.makedirs(self._sock_dir, exist_ok=True)
-        self._authkey = os.urandom(16)
+        self._authkey = (bytes.fromhex(config.authkey_hex)
+                         if config.authkey_hex else os.urandom(16))
         self._puller._authkey = self._authkey
         self._listener = multiprocessing.connection.Listener(
             os.path.join(self._sock_dir, "worker.sock"), "AF_UNIX",
@@ -437,8 +438,8 @@ class Runtime:
         # (reference: the GCS + raylet gRPC ports).  Head-host-local
         # workers keep the unix socket.
         self._tcp_listener = multiprocessing.connection.Listener(
-            (config.listen_host, 0), "AF_INET", backlog=512,
-            authkey=self._authkey)
+            (config.listen_host, config.listen_port), "AF_INET",
+            backlog=512, authkey=self._authkey)
         self.tcp_address = protocol.format_address(
             self._tcp_listener.address)
         self._tcp_accept_thread = threading.Thread(
@@ -470,6 +471,18 @@ class Runtime:
             target=self._task_sender_loop, daemon=True,
             name="ray_tpu-sender")
         self._sender.start()
+        # GCS-analog persistence: mutators bump _gcs_dirty; the snapshot
+        # thread writes when it changed (reference: GCS tables persisted
+        # to redis, redis_store_client.h:28).  Restore runs after the
+        # dispatch machinery is up — it re-creates named actors.
+        self._gcs_dirty = 0
+        self._gcs_snapshotted = 0
+        if config.gcs_restore and config.gcs_snapshot_path \
+                and os.path.exists(config.gcs_snapshot_path):
+            self._restore_gcs(config.gcs_snapshot_path)
+        if config.gcs_snapshot_path:
+            threading.Thread(target=self._gcs_snapshot_loop, daemon=True,
+                             name="ray_tpu-gcs-snap").start()
         atexit.register(self.shutdown)
 
     def _task_sender_loop(self):
@@ -1084,7 +1097,9 @@ class Runtime:
         func_id = serialization.dumps_inline(len(payload)).hex()[:8] + \
             __import__("hashlib").sha1(payload).hexdigest()[:16]
         with self.lock:
-            self.functions.setdefault(func_id, payload)
+            if func_id not in self.functions:
+                self.functions[func_id] = payload
+                self._gcs_dirty += 1
         return func_id
 
     def submit_task(self, spec: dict):
@@ -1820,10 +1835,114 @@ class Runtime:
             self.actors[actor_id] = actor
             self.tasks[spec["task_id"]] = rec
             self._resolve_deps_locked(rec)
+            self._gcs_dirty += 1
             if rec.deps_pending == 0:
                 self._enqueue_pending_locked(rec)
                 self._dispatch_locked()
         return actor_id
+
+    # --------------------------------------------- GCS snapshot/restore --
+    def _gcs_snapshot_loop(self):
+        while not self._stopped:
+            time.sleep(self.config.gcs_snapshot_interval_s)
+            if self._gcs_dirty != self._gcs_snapshotted:
+                try:
+                    self._snapshot_gcs()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _snapshot_gcs(self):
+        """Atomically persist head metadata — the GCS tables a restarted
+        head needs: KV, function payloads, named-actor creation specs,
+        job records (reference: redis_store_client.h:28; the reference
+        persists the same table set for GCS failover)."""
+        with self.lock:
+            ver = self._gcs_dirty
+            named = []
+            for (ns, name), aid in self.named_actors.items():
+                a = self.actors.get(aid)
+                if a is None or a.status == DEAD:
+                    continue
+                # Only inline init args survive a head restart (shm
+                # segments and refs of the dead session are meaningless).
+                args_ok = all(d[0] == protocol.INLINE
+                              for d in (a.init_args or ()))
+                kwargs_ok = all(d[0] == protocol.INLINE
+                                for d in (a.init_kwargs or {}).values())
+                if not (args_ok and kwargs_ok):
+                    continue
+                named.append({
+                    "namespace": ns, "name": name,
+                    "func_id": a.func_id,
+                    "init_args": list(a.init_args or ()),
+                    "init_kwargs": dict(a.init_kwargs or {}),
+                    "options": {k: v for k, v in a.options.items()
+                                if k != "scheduling_strategy"},
+                })
+            data = {
+                "kv": {ns: dict(tbl) for ns, tbl in self.kv.items()},
+                "functions": dict(self.functions),
+                "named_actors": named,
+                "jobs": self._snapshot_jobs_locked(),
+                "tcp_address": self.tcp_address,
+            }
+        blob = serialization.dumps_inline(data)
+        path = self.config.gcs_snapshot_path
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())  # torn snapshot = unrestartable head
+        os.replace(tmp, path)
+        self._gcs_snapshotted = ver
+
+    def _snapshot_jobs_locked(self):
+        mgr = getattr(self, "_job_manager", None)
+        if mgr is not None:
+            return mgr.snapshot_rows()
+        # No manager instantiated (yet): carry restored rows forward so a
+        # snapshot written before first job use can't wipe job history.
+        return list(getattr(self, "_restored_jobs", []) or [])
+
+    def _restore_gcs(self, path: str):
+        """Head restart: reload tables and re-create named actors from
+        their creation specs (reference: GcsInitData load + actor
+        restart-on-failover, gcs_server.h:77)."""
+        try:
+            with open(path, "rb") as f:
+                data = serialization.loads_inline(f.read())
+        except Exception as e:  # noqa: BLE001
+            # A corrupt snapshot must not make the head unstartable —
+            # that is the exact failure this feature exists to survive.
+            print(f"ray_tpu: GCS snapshot {path!r} unreadable ({e!r}); "
+                  f"starting fresh")
+            return
+        with self.lock:
+            for ns, tbl in data.get("kv", {}).items():
+                self.kv.setdefault(ns, {}).update(tbl)
+            self.functions.update(data.get("functions", {}))
+        self._restored_jobs = data.get("jobs", [])
+        for info in data.get("named_actors", []):
+            spec = {
+                "task_id": new_task_id().binary(),
+                "func_id": info["func_id"],
+                "args": info["init_args"],
+                "kwargs": info["init_kwargs"],
+                "num_returns": 1,
+                "name": f"{info['name']}.__restore__",
+                "resources": (info["options"].get("resources")
+                              or {"CPU": 1.0}),
+            }
+            opts = dict(info["options"])
+            opts["name"] = info["name"]
+            opts["namespace"] = info["namespace"]
+            try:
+                self.create_actor(spec, opts)
+            except Exception as e:  # noqa: BLE001
+                print(f"ray_tpu: could not restore actor "
+                      f"{info['name']!r}: {e!r}")
 
     def _enqueue_actor_task_locked(self, rec: TaskRecord):
         rec.actor_id = rec.spec["actor_id"]
@@ -1872,6 +1991,9 @@ class Runtime:
                 return
             if no_restart:
                 actor.restarts_left = 0
+                # Snapshot must observe the kill: a restarted head must
+                # not resurrect an actor the user explicitly destroyed.
+                self._gcs_dirty += 1
             worker = actor.worker
             if worker is not None:
                 try:
@@ -2763,6 +2885,7 @@ class Runtime:
         else:
             actor.status = DEAD
             actor.death_cause = err
+            self._gcs_dirty += 1
             self._fail_actor_queue_locked(actor, err)
 
     # ------------------------------------------------------------- reaper --
@@ -2815,6 +2938,7 @@ class Runtime:
             if not overwrite and key in ns:
                 return False
             ns[key] = value
+            self._gcs_dirty += 1
             return True
 
     def kv_get(self, key: bytes, namespace="default"):
@@ -2823,6 +2947,7 @@ class Runtime:
 
     def kv_del(self, key: bytes, namespace="default"):
         with self.lock:
+            self._gcs_dirty += 1
             return self.kv.get(namespace, {}).pop(key, None) is not None
 
     def kv_keys(self, prefix: bytes = b"", namespace="default"):

@@ -74,6 +74,7 @@ class JobManager:
         info.status = "RUNNING"
         with self._lock:
             self._jobs[job_id] = info
+        self._rt._gcs_dirty += 1
         threading.Thread(target=self._wait, args=(info,), daemon=True,
                          name=f"job-{job_id}").start()
         return job_id
@@ -84,6 +85,7 @@ class JobManager:
             if info.status == "RUNNING":
                 info.status = "SUCCEEDED" if rc == 0 else "FAILED"
             info.end_time = time.time()
+        self._rt._gcs_dirty += 1
 
     def status(self, job_id: str) -> str:
         with self._lock:
@@ -117,11 +119,36 @@ class JobManager:
         with self._lock:
             return [i.snapshot() for i in self._jobs.values()]
 
+    def snapshot_rows(self) -> List[Dict[str, Any]]:
+        """Rows for the head's GCS snapshot (persistence across head
+        restarts; reference: the GCS job table survives failover)."""
+        return self.list()
+
+    def adopt_rows(self, rows: List[Dict[str, Any]]):
+        """Re-adopt job records from a pre-restart snapshot.  Their
+        driver processes died with the old head: RUNNING/PENDING rows
+        become FAILED with a restart note."""
+        with self._lock:
+            for row in rows:
+                if row["job_id"] in self._jobs:
+                    continue
+                info = JobInfo(row["job_id"], row["entrypoint"], {})
+                info.status = ("FAILED"
+                               if row["status"] in ("PENDING", "RUNNING")
+                               else row["status"])
+                info.start_time = row.get("start_time", 0.0)
+                info.end_time = row.get("end_time")
+                info.log_path = row.get("log_path", "")
+                self._jobs[row["job_id"]] = info
+
 
 def _get_manager(runtime) -> JobManager:
     mgr = getattr(runtime, "_job_manager", None)
     if mgr is None:
         mgr = runtime._job_manager = JobManager(runtime)
+        restored = getattr(runtime, "_restored_jobs", None)
+        if restored:
+            mgr.adopt_rows(restored)
     return mgr
 
 
