@@ -76,6 +76,10 @@ class ClientRuntime(_WorkerRuntime):
             self.conn.close()
         except Exception:
             pass
+        from ray_tpu._private import api_internal
+
+        if api_internal.get_runtime() is self:
+            api_internal.set_global_runtime(None)
 
 
 def client_connect(address: str, authkey: bytes,
@@ -134,5 +138,10 @@ def client_connect(address: str, authkey: bytes,
 
     threading.Thread(target=flusher, daemon=True,
                      name="ray_tpu-client-flush").start()
-    object_ref_mod._set_runtime_accessor(lambda: rt)
+    # Route ObjectRef callbacks through the GLOBAL accessor, not a
+    # closure over this client: after disconnect + re-init, refs must
+    # see the new runtime, not a closed connection.
+    from ray_tpu._private import api_internal
+
+    object_ref_mod._set_runtime_accessor(api_internal.get_runtime)
     return rt
