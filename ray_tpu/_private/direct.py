@@ -1021,6 +1021,56 @@ class DirectCaller:
             st = self.owned.get(oid)
             return None if st is None else st.status
 
+    # ------------------------------------------------------------- spill --
+    def spill_owned(self, need_bytes: int, spill_dir: str) -> int:
+        """Move this worker's unpinned owned resident objects to disk
+        until ``need_bytes`` of shm is freed (per-node spilling;
+        reference: LocalObjectManager::SpillObjects,
+        local_object_manager.h:41 — the v1 design spilled only on the
+        head node, so a remote node under pressure just died).  DELEGATED
+        entries notify the head of the descriptor flip."""
+        victims = []
+        with self.lock:
+            total = 0
+            for oid, st in self.owned.items():
+                if (st.descr is not None and st.descr[0] == protocol.SHM
+                        and len(st.descr) > 3
+                        and st.descr[3] == self.host.store_id
+                        and st.creator is None
+                        and st.status in (READY, DELEGATED)
+                        and st.pins == 0 and not st.attached
+                        and not st.shipped):
+                    victims.append((oid, st))
+                    total += st.descr[2]
+                    if total >= need_bytes:
+                        break
+            for _oid, st in victims:
+                st.pins += 1  # survive concurrent frees while copying
+        freed = 0
+        updates = []
+        for oid, st in victims:
+            name, size = st.descr[1], st.descr[2]
+            try:
+                path = self.host.shm.spill(name, size, spill_dir)
+            except OSError:
+                path = None
+            with self.lock:
+                st.pins -= 1
+                if path is not None:
+                    st.descr = (protocol.SPILLED, path, size,
+                                self.host.store_id)
+                    freed += size
+                    if st.status == DELEGATED:
+                        updates.append((oid.binary(), st.descr))
+                self._maybe_free_locked(oid, st)
+        if updates:
+            try:
+                self.host.head_send(("descr_update", updates))
+            except Exception:
+                pass
+        self._flush_outbound()
+        return freed
+
     # ------------------------------------------------------------ export --
     def export_refs(self, oid_bins) -> None:
         """Make owned objects visible to the head (one-way delegation):

@@ -187,6 +187,22 @@ class NodeAgent:
         env.update(env_overrides)
         env["RAY_TPU_SHM_DIR_OVERRIDE"] = self.shm_dir
         env["RAY_TPU_STORE_ID"] = self.store_id
+        # THIS node's store policy wins over head defaults: its cap
+        # (default: 80% of the store filesystem, so an uncapped node
+        # can't fill tmpfs and die — per-node spilling engages instead)
+        # and its pool setting.
+        if "RAY_TPU_STORE_BYTES" in os.environ:
+            env["RAY_TPU_STORE_BYTES"] = os.environ["RAY_TPU_STORE_BYTES"]
+        else:
+            import shutil as _shutil
+
+            try:
+                total = _shutil.disk_usage(self.shm_dir).total
+                env["RAY_TPU_STORE_BYTES"] = str(int(total * 0.8))
+            except OSError:
+                pass
+        if "RAY_TPU_POOL_BYTES" in os.environ:
+            env["RAY_TPU_POOL_BYTES"] = os.environ["RAY_TPU_POOL_BYTES"]
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         existing = env.get("PYTHONPATH", "")

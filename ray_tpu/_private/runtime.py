@@ -613,10 +613,24 @@ class Runtime:
         if st.refcount() <= 0 and not st.futures and not st.waiters:
             self.objects.pop(oid, None)
             if st.descr is not None and st.descr[0] == protocol.SPILLED:
-                try:
-                    os.unlink(st.descr[1])
-                except OSError:
-                    pass
+                home = (st.descr[3] if len(st.descr) > 3
+                        else self.store_id)
+                if home == self.store_id:
+                    try:
+                        os.unlink(st.descr[1])
+                    except OSError:
+                        pass
+                else:
+                    # Spill file lives on the owner node: route the
+                    # unlink there (the agent's unlink handles absolute
+                    # paths).
+                    agent = self._agents.get(home)
+                    if agent is not None and not agent.dead:
+                        try:
+                            agent.send(("unlink_segment", st.descr[1],
+                                        st.descr[2]))
+                        except Exception:
+                            pass
             if st.descr is not None and st.descr[0] == protocol.SHM:
                 home = st.descr[3] if len(st.descr) > 3 else self.store_id
                 cw = st.creator
@@ -870,13 +884,19 @@ class Runtime:
                     st2.segment = seg
         elif kind == protocol.SPILLED:
             # Restore from external storage (reference:
-            # local_object_manager.h restore path).
-            seg = self.shm.attach_path(descr[1])
-            value = seg.deserialize()
-            with self.lock:
-                st2 = self.objects.get(oid)
-                if st2 is not None:
-                    st2.segment = seg
+            # local_object_manager.h restore path).  Spill files written
+            # by a REMOTE node only exist there: ship the parts.
+            home = descr[3] if len(descr) > 3 else self.store_id
+            if home != self.store_id and not os.path.exists(descr[1]):
+                meta, bufs = self._fetch_parts(descr)
+                value = serialization.loads(meta, bufs)
+            else:
+                seg = self.shm.attach_path(descr[1])
+                value = seg.deserialize()
+                with self.lock:
+                    st2 = self.objects.get(oid)
+                    if st2 is not None:
+                        st2.segment = seg
         else:  # error
             raise serialization.loads_inline(descr[1])
         with self.lock:
@@ -1497,6 +1517,10 @@ class Runtime:
             "RAY_TPU_NODE_ID": node.node_id.hex(),
             "RAY_TPU_JOB_ID": self.job_id.hex(),
             "RAY_TPU_POOL_BYTES": str(self.config.shm_pool_bytes),
+            # Per-process slice of the node store cap + the shared spill
+            # dir (per-node spilling; local_object_manager.h:41).
+            "RAY_TPU_STORE_BYTES": str(self.config.object_store_memory),
+            "RAY_TPU_SPILL_DIR_OVERRIDE": self.spill_dir,
         })
         env["RAY_TPU_STORE_ID"] = self.store_id
         proc = subprocess.Popen(
@@ -2529,6 +2553,15 @@ class Runtime:
                         st.exporter = None
                     self._complete_object_locked(oid, descr, bool(ok),
                                                  creator=cw)
+        elif tag == "descr_update":
+            # Owner spilled a delegated object: its head descriptor
+            # flips to the spill location (consumers restore through
+            # the normal SPILLED paths).
+            with self.lock:
+                for b, descr in msg[1]:
+                    st = self.objects.get(ObjectID(b))
+                    if st is not None and st.status != PENDING:
+                        st.descr = descr
         elif tag == "free_remote":
             # Owner-side free of a segment homed in another store (its
             # direct conn to the creator is gone): route the unlink.

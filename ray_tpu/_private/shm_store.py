@@ -143,6 +143,18 @@ class ShmStore:
         self._session = session_id or os.urandom(4).hex()
         self._lock = threading.Lock()
         self._used = 0
+        # Per-NODE accounting: every process writing this directory under
+        # a capacity shares one flock'd counter file, so the cap bounds
+        # the node's aggregate usage, not each process's (the reference
+        # has one plasma store process per node; we have N writers).
+        self._acct_fd = None
+        if capacity:
+            acct = os.path.join(self._dir, f".rtpu-acct-{self._session}")
+            try:
+                self._acct_fd = os.open(acct, os.O_CREAT | os.O_RDWR,
+                                        0o600)
+            except OSError:
+                self._acct_fd = None
         self._created: set[str] = set()
         # Segment pool: freed-but-still-mapped segments kept for reuse.
         # Fresh tmpfs pages cost a fault + zero-fill per 4K page (~1 GB/s on
@@ -157,6 +169,31 @@ class ShmStore:
         self._pool_bytes = 0
         self._pool: List[Tuple[int, str, mmap.mmap]] = []  # sorted by size
         self._live_mm: dict = {}  # name -> (mmap, alloc_size), pool=True only
+
+    def _acct(self, delta: int) -> int:
+        """Atomically add ``delta`` to the node-shared usage counter;
+        returns the new value.  Caller holds self._lock."""
+        if self._acct_fd is None:
+            return self._used
+        import fcntl
+
+        fcntl.flock(self._acct_fd, fcntl.LOCK_EX)
+        try:
+            os.lseek(self._acct_fd, 0, os.SEEK_SET)
+            raw = os.read(self._acct_fd, 16)
+            cur = int(raw.decode() or "0") if raw else 0
+            cur = max(0, cur + delta)
+            os.lseek(self._acct_fd, 0, os.SEEK_SET)
+            os.ftruncate(self._acct_fd, 0)
+            os.write(self._acct_fd, str(cur).encode())
+            return cur
+        finally:
+            fcntl.flock(self._acct_fd, fcntl.LOCK_UN)
+
+    def _node_used(self) -> int:
+        if self._acct_fd is None:
+            return self._used
+        return self._acct(0)
 
     def segment_name(self, object_id: ObjectID) -> str:
         return f"rtpu-{self._session}-{object_id.hex()}"
@@ -185,6 +222,7 @@ class ShmStore:
             mm.close()
         with self._lock:
             self._used += alloc
+            self._acct(alloc)
             self._created.add(name)
         return name, alloc
 
@@ -223,6 +261,7 @@ class ShmStore:
                         self._pool.pop(i)
                         self._pool_bytes -= size
                         self._used -= size  # re-added by create_from_parts
+                        self._acct(-size)
                         # Rename to the new object's canonical name: the
                         # mmap stays valid (it binds the inode, not the
                         # path) and the segment-name -> ObjectID invariant
@@ -233,16 +272,19 @@ class ShmStore:
                     break  # sorted: everything later is even more wasteful
             if self._capacity:
                 # Pooled bytes are free memory: give them back before
-                # declaring the store full.
-                while self._used + total > self._capacity and self._pool:
+                # declaring the store full.  The cap applies to the whole
+                # NODE's usage (shared counter), not this process's.
+                node_used = self._node_used()
+                while node_used + total > self._capacity and self._pool:
                     size, name, mm = self._pool.pop()
                     self._pool_bytes -= size
                     self._used -= size
+                    node_used = self._acct(-size)
                     evict.append((name, mm))
-                if self._used + total > self._capacity:
+                if node_used + total > self._capacity:
                     raise MemoryError(
                         f"Object store over capacity: need {total}, "
-                        f"used {self._used}/{self._capacity}")
+                        f"node used {node_used}/{self._capacity}")
         for name, mm in evict:
             try:
                 mm.close()
@@ -335,14 +377,21 @@ class ShmStore:
             except BufferError:
                 pass
         path = _segment_path(self._dir, name)
+        removed = False
         try:
             os.unlink(path)
+            removed = True
         except FileNotFoundError:
             pass
         with self._lock:
             if name in self._created:
                 self._created.discard(name)
                 self._used -= size
+                self._acct(-size)
+            elif removed and size:
+                # Another process created this segment (owner-routed
+                # free): its bytes leave the node-shared count here.
+                self._acct(-size)
 
     def cleanup(self):
         """Unlink everything this process created (driver shutdown path)."""
@@ -366,3 +415,11 @@ class ShmStore:
                 os.unlink(_segment_path(self._dir, name))
             except OSError:
                 pass
+        if self._acct_fd is not None:
+            try:
+                os.close(self._acct_fd)
+                os.unlink(os.path.join(self._dir,
+                                       f".rtpu-acct-{self._session}"))
+            except OSError:
+                pass
+            self._acct_fd = None

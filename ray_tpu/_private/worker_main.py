@@ -73,6 +73,11 @@ class _WorkerRuntime:
         # Which host object store this worker can mmap directly; SHM
         # descriptors from other stores are shipped as parts via the driver.
         self.store_id = os.environ.get("RAY_TPU_STORE_ID", "")
+        # Per-node spill directory: deterministic from the session so
+        # every process on a node (and the head's restore path) agrees.
+        self.spill_dir = os.environ.get(
+            "RAY_TPU_SPILL_DIR_OVERRIDE",
+            f"/tmp/ray_tpu_spill_{os.environ.get('RAY_TPU_SESSION', '')}")
         self.assigned_resources: Dict[str, float] = {}
         self.tpu_chips: list = []
         # Objects fetched or created locally, cached: id -> value (LRU).
@@ -279,11 +284,26 @@ class _WorkerRuntime:
 
     def serialize_value(self, value: Any, object_id: ObjectID):
         """Value -> descriptor, choosing inline vs shm by size (one
-        serialization pass; shm buffers memcpy'd once, into the segment)."""
+        serialization pass; shm buffers memcpy'd once, into the segment).
+        Store-full falls back to per-node spilling then direct-to-disk
+        (reference: LocalObjectManager spilling + plasma's
+        CreateRequestQueue fallback, local_object_manager.h:41)."""
         res = serialization.dumps_adaptive(value, self.max_inline)
         if res[0] == "inline":
             return (protocol.INLINE, res[1])
-        name, size = self.shm.create_from_parts(object_id, res[1], res[2])
+        try:
+            name, size = self.shm.create_from_parts(object_id, res[1],
+                                                    res[2])
+        except MemoryError:
+            need = sum(len(b) for b in res[2]) + len(res[1]) + 65536
+            self.direct.spill_owned(need, self.spill_dir)
+            try:
+                name, size = self.shm.create_from_parts(object_id, res[1],
+                                                        res[2])
+            except MemoryError:
+                path, size = self.shm.create_spilled(
+                    object_id, res[1], res[2], self.spill_dir)
+                return (protocol.SPILLED, path, size, self.store_id)
         return (protocol.SHM, name, size, self.store_id)
 
     # -- runtime accessor API (mirrors driver Runtime) ---------------------
@@ -768,6 +788,7 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
     # to the creating worker) — without this, every worker-side put writes
     # fresh tmpfs pages at fault+zero speed instead of memcpy speed.
     shm = ShmStore(shm_dir=shm_dir, session_id=session,
+                   capacity=int(os.environ.get("RAY_TPU_STORE_BYTES", "0")),
                    pool_bytes=int(os.environ.get("RAY_TPU_POOL_BYTES", "0")))
     rt = _WorkerRuntime(conn, send_lock, shm, max_inline)
     rt.worker_id_hex = worker_id_hex

@@ -40,7 +40,8 @@ class Cluster:
     def add_node(self, num_cpus: float = 1.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
-                 external: bool = False, wait: bool = True):
+                 external: bool = False, wait: bool = True,
+                 env_overrides: Optional[Dict[str, str]] = None):
         if not external:
             return self.rt.add_node(num_cpus=num_cpus, num_tpus=num_tpus,
                                     resources=resources, labels=labels)
@@ -52,6 +53,8 @@ class Cluster:
         shm_dir = tempfile.mkdtemp(prefix="ray_tpu_node_")
         self._agent_dirs.append(shm_dir)
         env = dict(os.environ)
+        if env_overrides:
+            env.update(env_overrides)
         env.update({
             "RAY_TPU_HEAD_ADDRESS": self.rt.tcp_address,
             "RAY_TPU_AUTHKEY": self.rt._authkey.hex(),
