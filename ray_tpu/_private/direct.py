@@ -1144,7 +1144,8 @@ class DirectServer:
 
     def __init__(self, authkey: bytes, enqueue: Callable[[dict, Any], None],
                  register_func: Callable[[str, bytes], None],
-                 shm_unlink: Callable[[str, int, bool], None]):
+                 shm_unlink: Callable[[str, int, bool], None],
+                 on_peer_msg: Optional[Callable] = None):
         from multiprocessing.connection import Listener
 
         host = os.environ.get("RAY_TPU_AGENT_LISTEN_HOST", "127.0.0.1")
@@ -1161,6 +1162,7 @@ class DirectServer:
         self._enqueue = enqueue
         self._register_func = register_func
         self._shm_unlink = shm_unlink
+        self._on_peer_msg = on_peer_msg
         self._stopped = False
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="ray_tpu-direct-accept").start()
@@ -1199,6 +1201,18 @@ class DirectServer:
                     self._shm_unlink(msg[1], msg[2], msg[3])
                 except Exception:
                     pass
+            elif tag == "dmsg":
+                # Generic peer-to-peer message (host-tier ring
+                # collectives ride this; reference: the Gloo transport's
+                # peer channels).  (channel, payload) dispatched to the
+                # process-local handler registry.
+                if self._on_peer_msg is not None:
+                    try:
+                        self._on_peer_msg(msg[1], msg[2])
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
 
     def close(self):
         self._stopped = True

@@ -78,6 +78,11 @@ class _WorkerRuntime:
         self.spill_dir = os.environ.get(
             "RAY_TPU_SPILL_DIR_OVERRIDE",
             f"/tmp/ray_tpu_spill_{os.environ.get('RAY_TPU_SESSION', '')}")
+        # Peer messaging over the direct-push listener: channel ->
+        # handler(payload).  Host-tier collectives register here.
+        self.direct_addr = None  # set by worker_entry
+        self.peer_handlers: Dict[str, Any] = {}
+        self._peer_handlers_lock = threading.Lock()
         self.assigned_resources: Dict[str, float] = {}
         self.tpu_chips: list = []
         # Objects fetched or created locally, cached: id -> value (LRU).
@@ -107,6 +112,21 @@ class _WorkerRuntime:
         # the head is only the lease scheduler for them).
         self._fn_payloads: Dict[str, bytes] = {}
         self.direct = direct_mod.DirectCaller(self)
+
+    # -- peer messaging (ring collectives etc.) ----------------------------
+    def register_peer_handler(self, channel: str, fn):
+        with self._peer_handlers_lock:
+            self.peer_handlers[channel] = fn
+
+    def unregister_peer_handler(self, channel: str):
+        with self._peer_handlers_lock:
+            self.peer_handlers.pop(channel, None)
+
+    def dispatch_peer_msg(self, channel: str, payload):
+        with self._peer_handlers_lock:
+            fn = self.peer_handlers.get(channel)
+        if fn is not None:
+            fn(payload)
 
     # -- DirectCaller host adapter -----------------------------------------
     def head_request(self, msg_builder):
@@ -884,7 +904,9 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
 
     direct_server = direct_mod.DirectServer(
         bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")),
-        direct_enqueue, fns.put, rt.shm.unlink)
+        direct_enqueue, fns.put, rt.shm.unlink,
+        on_peer_msg=rt.dispatch_peer_msg)
+    rt.direct_addr = direct_server.address
 
     def decref_flusher():
         import time as _time
