@@ -41,6 +41,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # finite "minus infinity": keeps exp() NaN-free on masked rows
 _LANES = 128     # TPU lane width; stats are lane-replicated
+# Softmax runs in the log2 domain: q is pre-scaled by sm_scale*log2(e)
+# outside the kernel, so the hot loop uses exp2 directly (the VPU's
+# native transcendental; exp(x) lowers to exp2(x*log2e) anyway) and the
+# per-element scale multiply disappears from the (bq, bk) tile.
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
 
 # Tuned on TPU v5e: large blocks amortize grid overhead (the d=64
 # contraction underfills the MXU, so throughput comes from big output
@@ -92,7 +98,7 @@ def _causal_mask(s, qi, ki, block_q, block_k):
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q, block_k):
+                m_scr, l_scr, acc_scr, *, causal, block_q, block_k):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -111,14 +117,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k = k_ref[0, 0]                                      # (bk, d)
         v = v_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
+                                preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         m_prev = m_scr[...]                          # (bq, LANES) replicated
         m_cur = jnp.max(s, axis=-1, keepdims=True)   # (bq, 1)
         m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
-        alpha = jnp.exp(m_prev - m_next)
-        p = jnp.exp(s - m_next[:, :1])
+        alpha = jnp.exp2(m_prev - m_next)
+        p = jnp.exp2(s - m_next[:, :1])
         l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=-1, keepdims=True), m_prev.shape)
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
@@ -130,18 +136,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = l_scr[...]
         o_ref[0, 0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[...] + jnp.log(l)
+        lse_ref[0, 0] = m_scr[...] + jnp.log2(l)   # log2-domain lse
 
 
-def _fwd_call(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret):
-    """qt/kt/vt: (b, h, s, d).  Returns (o_t, lse) with o_t (b, h, sq, d)
-    and lse (b, h, sq, LANES) lane-replicated f32."""
+def _fwd_call(qt, kt, vt, causal, block_q, block_k, interpret):
+    """qt/kt/vt: (b, h, s, d); qt PRE-SCALED by sm_scale*log2e.  Returns
+    (o_t, lse) with o_t (b, h, sq, d) and lse (b, h, sq, LANES)
+    lane-replicated f32 in the log2 domain."""
     b, h, sq, d = qt.shape
     sk = kt.shape[2]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(_fwd_kernel, causal=causal,
                           block_q=block_q, block_k=block_k),
         grid=(b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
         in_specs=[
@@ -173,7 +180,7 @@ def _fwd_call(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret):
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_scr, dv_scr,
-                 *, sm_scale, causal, block_q, block_k):
+                 *, causal, block_q, block_k):
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -193,28 +200,33 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0]                                   # (bq, LANES)
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
+                                preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse[:, :1])                           # (bq, bk)
+        p = jnp.exp2(s - lse[:, :1])                          # (bq, bk)
+        # Grad matmuls in the INPUT dtype (bf16 on TPU): the MXU runs
+        # bf16 natively; the old f32 operands forced multi-pass matmuls.
         dv_scr[...] += jax.lax.dot_general(
-            p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, :1]) * sm_scale
+        ds = (p * (dp - delta[:, :1])).astype(q.dtype)
         dk_scr[...] += jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        # q arrives pre-scaled by c = sm_scale*log2e; the true gradient
+        # is sm_scale * ds^T @ q_unscaled = ln2 * ds^T @ (q*c).
+        dk_ref[0, 0] = (dk_scr[...] * _LN2).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k):
+    # sm_scale is applied once at finalize: dL/dq_orig = sm_scale * ds@k.
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -233,20 +245,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
+                                preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse[:, :1])
+        p = jnp.exp2(s - lse[:, :1])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, :1]) * sm_scale
+        ds = (p * (dp - delta[:, :1])).astype(k.dtype)
         dq_scr[...] += jax.lax.dot_general(
-            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+        dq_ref[0, 0] = (dq_scr[...] * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_call(qt, kt, vt, ot, lse, dot, sm_scale, causal, block_q, block_k,
@@ -271,7 +283,7 @@ def _bwd_call(qt, kt, vt, ot, lse, dot, sm_scale, causal, block_q, block_k,
                          lambda b_, h_, i, j: (b_, h_, j, 0))
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(_dkdv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k),
         grid=(b, h, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q)),
         in_specs=[q_j, k_i, k_i, q_j, row_j, row_j],
@@ -306,15 +318,16 @@ def _to_bhsd(x):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, _ = _fwd_call(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), sm_scale, causal,
+    qs = (q * (sm_scale * _LOG2E)).astype(q.dtype)
+    o, _ = _fwd_call(_to_bhsd(qs), _to_bhsd(k), _to_bhsd(v), causal,
                      block_q, block_k, interpret)
     return _to_bhsd(o)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    qt, kt, vt = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
-    ot, lse = _fwd_call(qt, kt, vt, sm_scale, causal, block_q, block_k,
-                        interpret)
+    qs = (q * (sm_scale * _LOG2E)).astype(q.dtype)
+    qt, kt, vt = _to_bhsd(qs), _to_bhsd(k), _to_bhsd(v)
+    ot, lse = _fwd_call(qt, kt, vt, causal, block_q, block_k, interpret)
     return _to_bhsd(ot), (qt, kt, vt, ot, lse)
 
 
