@@ -26,12 +26,18 @@ from ray_tpu.data.dataset import (
     read_parquet,
     read_text,
 )
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
+from ray_tpu.data.grouped_dataset import (
+    AggregateFn, Count, GroupedDataset, Max, Mean, Min, Std, Sum,
+)
 
 # `range` shadows the builtin inside this namespace on purpose — the
 # reference exposes ray.data.range the same way.
 range = range_
 
 __all__ = [
-    "Dataset", "from_arrow", "from_items", "from_numpy", "from_pandas",
+    "Dataset", "DatasetPipeline", "GroupedDataset", "AggregateFn",
+    "Count", "Sum", "Min", "Max", "Mean", "Std",
+    "from_arrow", "from_items", "from_numpy", "from_pandas",
     "range", "read_csv", "read_json", "read_parquet", "read_text",
 ]

@@ -169,6 +169,75 @@ def _merge_sorted(key, descending, *blocks):
     return list(heapq.merge(*blocks, key=keyfn, reverse=descending))
 
 
+def _keyfn_of(key):
+    if isinstance(key, str):
+        return lambda r: r[key]
+    return key or (lambda r: r)
+
+
+@ray.remote
+def _sample_block(block, k, key):
+    """Evenly-spaced key samples for range partitioning (reference:
+    sort sampling in _internal/sort.py — sample, pick boundaries,
+    partition)."""
+    rows = list(_block_rows(block))
+    if not rows:
+        return []
+    keyfn = _keyfn_of(key)
+    idx = np.linspace(0, len(rows) - 1,
+                      min(k, len(rows))).astype(int)
+    return [keyfn(rows[int(i)]) for i in idx]
+
+
+@ray.remote
+def _range_partition(block, key, descending, bounds):
+    """Bucket rows by the sampled boundaries: bucket i holds keys in
+    (bounds[i-1], bounds[i]].  num_returns = len(bounds) + 1."""
+    import bisect
+
+    keyfn = _keyfn_of(key)
+    n_out = len(bounds) + 1
+    buckets = [[] for _ in builtins.range(n_out)]
+    for r in _block_rows(block):
+        i = bisect.bisect_left(bounds, keyfn(r))
+        if descending:
+            i = n_out - 1 - i
+        buckets[i].append(r)
+    return buckets if n_out > 1 else buckets[0]
+
+
+@ray.remote
+def _sort_range(key, descending, *parts):
+    rows = list(itertools.chain(*parts))
+    rows.sort(key=_keyfn_of(key), reverse=descending)
+    return rows
+
+
+@ray.remote
+def _hash_partition(block, key, num_reducers):
+    """Hash rows to reducers by group key (push-based shuffle map side)."""
+    keyfn = _keyfn_of(key)
+    buckets = [[] for _ in builtins.range(num_reducers)]
+    for r in _block_rows(block):
+        buckets[hash(keyfn(r)) % num_reducers].append(r)
+    return buckets if num_reducers > 1 else buckets[0]
+
+
+@ray.remote
+def _zip_blocks(a, b):
+    ra, rb = list(_block_rows(a)), list(_block_rows(b))
+    out = []
+    for x, y in zip(ra, rb):
+        if isinstance(x, dict) and isinstance(y, dict):
+            merged = dict(x)
+            for k2, v2 in y.items():
+                merged[k2 if k2 not in merged else f"{k2}_1"] = v2
+            out.append(merged)
+        else:
+            out.append((x, y))
+    return out
+
+
 @ray.remote
 def _shuffle_map(block, num_reducers, seed):
     rng = np.random.default_rng(seed)
@@ -355,11 +424,89 @@ class Dataset:
 
     def sort(self, key: Union[str, Callable, None] = None,
              descending: bool = False) -> "Dataset":
+        """Distributed sample-partition-sort (reference:
+        _internal/push_based_shuffle.py + sort.py): sample each block for
+        range boundaries, partition rows to P reducers, sort per range.
+        Output is P globally-ordered blocks — no single-task merge, no
+        O(dataset) memory on one worker (the v1 design concatenated
+        every block in ONE reducer)."""
         blocks = self._executed_refs()
-        sorted_blocks = [_sort_block.remote(b, key, descending)
-                         for b in blocks]
-        merged = _merge_sorted.remote(key, descending, *sorted_blocks)
-        return Dataset([merged])
+        n = len(blocks)
+        if n == 0:
+            return Dataset([])
+        if n == 1:
+            return Dataset([_sort_block.remote(blocks[0], key, descending)])
+        samples = ray.get([_sample_block.remote(b, 16, key)
+                           for b in blocks])
+        flat = sorted(s for part in samples for s in part)
+        if not flat:
+            return Dataset(blocks)
+        # P-1 boundaries at even sample quantiles.
+        bounds = [flat[len(flat) * (i + 1) // n]
+                  for i in builtins.range(n - 1)]
+        parts = [_range_partition.options(num_returns=n).remote(
+            b, key, descending, bounds) for b in blocks]
+        if n == 1:
+            parts = [[p] for p in parts]
+        out = [_sort_range.remote(key, descending,
+                                  *[parts[i][j] for i in builtins.range(n)])
+               for j in builtins.range(n)]
+        return Dataset(out)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-wise zip (reference: dataset.py Dataset.zip): the other
+        dataset is re-sliced to this one's block row boundaries, then
+        blocks pair off in per-block tasks."""
+        blocks = self._executed_refs()
+        counts = ray.get([_count_block.remote(b) for b in blocks])
+        bounds = list(itertools.accumulate(counts))
+        other_blocks = other._executed_refs()
+        other_counts = ray.get([_count_block.remote(b)
+                                for b in other_blocks])
+        if sum(counts) != sum(other_counts):
+            raise ValueError(
+                f"zip requires equal row counts: {sum(counts)} vs "
+                f"{sum(other_counts)}")
+        plans = _plan_row_ranges(other_counts, bounds)
+        out = []
+        for mine, plan in zip(blocks, plans):
+            if len(plan) == 1:
+                bi, s, e = plan[0]
+                theirs = (other_blocks[bi]
+                          if (s, e) == (0, other_counts[bi])
+                          else _slice_block.remote(other_blocks[bi], s, e))
+            else:
+                theirs = _concat_slices.remote(
+                    [(i, s, e) for i, s, e in plan],
+                    *[other_blocks[bi] for bi, _, _ in plan])
+            out.append(_zip_blocks.remote(mine, theirs))
+        return Dataset(out)
+
+    def groupby(self, key: Union[str, Callable]) -> "GroupedDataset":
+        """reference: grouped_dataset.py Dataset.groupby."""
+        from ray_tpu.data.grouped_dataset import GroupedDataset
+
+        return GroupedDataset(self, key)
+
+    def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
+        """Split into a pipeline of windows executed one at a time
+        (reference: dataset_pipeline.py Dataset.window)."""
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        pairs = [(b, ops) for blocks, ops in self._segments
+                 for b in blocks]
+        windows = []
+        for i in builtins.range(0, len(pairs), blocks_per_window):
+            chunk = pairs[i:i + blocks_per_window]
+            windows.append(Dataset._from_segments(
+                [([b], ops) for b, ops in chunk]))
+        return DatasetPipeline(windows)
+
+    def repeat(self, times: int = 1) -> "DatasetPipeline":
+        """reference: dataset_pipeline.py Dataset.repeat."""
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        return DatasetPipeline([self] * times)
 
     def union(self, *others: "Dataset") -> "Dataset":
         """Lazy concatenation: segments are appended, not executed — the

@@ -1,0 +1,80 @@
+"""Windowed/repeated dataset pipelines.
+
+Reference: ``python/ray/data/dataset_pipeline.py`` — a DatasetPipeline is
+a sequence of Datasets (windows) executed one window at a time, so a
+training loop streams through data larger than the object store instead
+of materializing it all.  Transforms apply lazily per window; iteration
+drives exactly one window's tasks at a time (each window's own streaming
+executor bounds in-flight blocks within it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List
+
+from ray_tpu.data.dataset import Dataset
+
+
+class DatasetPipeline:
+    def __init__(self, windows: List[Dataset]):
+        self._windows = list(windows)
+
+    # ------------------------------------------------------- transforms --
+    def _map_windows(self, f: Callable[[Dataset], Dataset]
+                     ) -> "DatasetPipeline":
+        return DatasetPipeline([f(w) for w in self._windows])
+
+    def map(self, fn) -> "DatasetPipeline":
+        return self._map_windows(lambda w: w.map(fn))
+
+    def filter(self, fn) -> "DatasetPipeline":
+        return self._map_windows(lambda w: w.filter(fn))
+
+    def flat_map(self, fn) -> "DatasetPipeline":
+        return self._map_windows(lambda w: w.flat_map(fn))
+
+    def map_batches(self, fn, *, batch_format: str = "numpy"
+                    ) -> "DatasetPipeline":
+        return self._map_windows(
+            lambda w: w.map_batches(fn, batch_format=batch_format))
+
+    def random_shuffle_each_window(self, *, seed=None) -> "DatasetPipeline":
+        return self._map_windows(lambda w: w.random_shuffle(seed=seed))
+
+    def repeat(self, times: int = 1) -> "DatasetPipeline":
+        return DatasetPipeline(self._windows * times)
+
+    # -------------------------------------------------------- consumers --
+    def iter_datasets(self) -> Iterator[Dataset]:
+        yield from self._windows
+
+    def iter_rows(self) -> Iterator[Any]:
+        for w in self._windows:
+            yield from w.iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy") -> Iterator[Any]:
+        for w in self._windows:
+            yield from w.iter_batches(batch_size=batch_size,
+                                      batch_format=batch_format)
+
+    def split(self, n: int) -> List["DatasetPipeline"]:
+        """Shard each window for n consumers (reference:
+        dataset_pipeline.py split)."""
+        per_window = [w.split(n) for w in self._windows]
+        return [DatasetPipeline([pw[i] for pw in per_window])
+                for i in range(n)]
+
+    def count(self) -> int:
+        return sum(w.count() for w in self._windows)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for w in self._windows:
+            out.extend(w.take(n - len(out)))
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def __repr__(self):
+        return f"DatasetPipeline(num_windows={len(self._windows)})"

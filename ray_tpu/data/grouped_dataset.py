@@ -1,0 +1,195 @@
+"""Grouped aggregation over Datasets.
+
+Reference: ``python/ray/data/grouped_dataset.py`` (GroupedDataset with
+count/sum/min/max/mean/std + AggregateFn) and ``aggregate.py`` — same API
+surface, re-built on this Dataset's hash-partition shuffle: map tasks
+bucket rows by group-key hash, one reduce task per bucket folds its
+groups with the AggregateFns.  No driver materialization; the output is
+one block per reducer of ``{key, agg_name: value}`` rows.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+import math
+from typing import Any, Callable, List, Optional, Union
+
+import ray_tpu as ray
+from ray_tpu.data.dataset import (
+    Dataset, _block_rows, _hash_partition, _keyfn_of,
+)
+
+
+class AggregateFn:
+    """reference: aggregate.py AggregateFn — init/accumulate/merge/
+    finalize fold protocol."""
+
+    def __init__(self, init: Callable[[], Any],
+                 accumulate: Callable[[Any, Any], Any],
+                 merge: Callable[[Any, Any], Any],
+                 finalize: Callable[[Any], Any] = lambda a: a,
+                 name: str = "agg"):
+        self.init = init
+        self.accumulate = accumulate
+        self.merge = merge
+        self.finalize = finalize
+        self.name = name
+
+
+def _value_getter(on: Optional[Union[str, Callable]]):
+    if on is None:
+        return lambda r: r
+    if isinstance(on, str):
+        return lambda r: r[on]
+    return on
+
+
+def Count() -> AggregateFn:
+    return AggregateFn(lambda: 0, lambda a, _r: a + 1,
+                       lambda a, b: a + b, name="count()")
+
+
+def Sum(on=None) -> AggregateFn:
+    get = _value_getter(on)
+    return AggregateFn(lambda: 0, lambda a, r: a + get(r),
+                       lambda a, b: a + b,
+                       name=f"sum({on if isinstance(on, str) else ''})")
+
+
+def Min(on=None) -> AggregateFn:
+    get = _value_getter(on)
+    return AggregateFn(lambda: None,
+                       lambda a, r: get(r) if a is None
+                       else min(a, get(r)),
+                       lambda a, b: b if a is None
+                       else (a if b is None else min(a, b)),
+                       name=f"min({on if isinstance(on, str) else ''})")
+
+
+def Max(on=None) -> AggregateFn:
+    get = _value_getter(on)
+    return AggregateFn(lambda: None,
+                       lambda a, r: get(r) if a is None
+                       else max(a, get(r)),
+                       lambda a, b: b if a is None
+                       else (a if b is None else max(a, b)),
+                       name=f"max({on if isinstance(on, str) else ''})")
+
+
+def Mean(on=None) -> AggregateFn:
+    get = _value_getter(on)
+    return AggregateFn(lambda: (0, 0),
+                       lambda a, r: (a[0] + get(r), a[1] + 1),
+                       lambda a, b: (a[0] + b[0], a[1] + b[1]),
+                       lambda a: a[0] / a[1] if a[1] else None,
+                       name=f"mean({on if isinstance(on, str) else ''})")
+
+
+def Std(on=None, ddof: int = 1) -> AggregateFn:
+    get = _value_getter(on)
+
+    def fin(a):
+        s, s2, n = a
+        if n <= ddof:
+            return None
+        var = (s2 - s * s / n) / (n - ddof)
+        return math.sqrt(max(0.0, var))
+
+    return AggregateFn(lambda: (0.0, 0.0, 0),
+                       lambda a, r: (a[0] + get(r),
+                                     a[1] + get(r) ** 2, a[2] + 1),
+                       lambda a, b: (a[0] + b[0], a[1] + b[1],
+                                     a[2] + b[2]),
+                       fin,
+                       name=f"std({on if isinstance(on, str) else ''})")
+
+
+@ray.remote
+def _agg_reduce(key, aggs: List[AggregateFn], *parts):
+    """One reducer: fold its bucket's rows per group, emit result rows."""
+    keyfn = _keyfn_of(key)
+    accs = {}  # group key -> [acc per agg]
+    for r in itertools.chain(*parts):
+        k = keyfn(r)
+        acc = accs.get(k)
+        if acc is None:
+            acc = accs[k] = [a.init() for a in aggs]
+        for i, a in enumerate(aggs):
+            acc[i] = a.accumulate(acc[i], r)
+    key_col = key if isinstance(key, str) else "key"
+    out = []
+    for k in sorted(accs, key=lambda x: (x is None, x)):
+        row = {key_col: k}
+        for a, acc in zip(aggs, accs[k]):
+            row[a.name] = a.finalize(acc)
+        out.append(row)
+    return out
+
+
+@ray.remote
+def _map_groups_task(key, fn, *parts):
+    keyfn = _keyfn_of(key)
+    groups = {}
+    for r in itertools.chain(*parts):
+        groups.setdefault(keyfn(r), []).append(r)
+    out = []
+    for k in sorted(groups, key=lambda x: (x is None, x)):
+        res = fn(groups[k])
+        out.extend(res if isinstance(res, list) else [res])
+    return out
+
+
+class GroupedDataset:
+    """reference: grouped_dataset.py:GroupedDataset."""
+
+    def __init__(self, ds: Dataset, key: Union[str, Callable]):
+        self._ds = ds
+        self._key = key
+
+    def _shuffled_parts(self):
+        blocks = self._ds._executed_refs()
+        n = max(1, len(blocks))
+        parts = [_hash_partition.options(num_returns=n).remote(
+            b, self._key, n) for b in blocks]
+        if n == 1:
+            parts = [[p] for p in parts]
+        return n, parts
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        if not aggs:
+            raise ValueError("aggregate() needs at least one AggregateFn")
+        n, parts = self._shuffled_parts()
+        out = [_agg_reduce.remote(self._key, list(aggs),
+                                  *[parts[i][j]
+                                    for i in builtins.range(len(parts))])
+               for j in builtins.range(n)]
+        return Dataset(out)
+
+    def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
+        """reference: grouped_dataset.py map_groups — fn sees the full
+        row list of one group."""
+        n, parts = self._shuffled_parts()
+        out = [_map_groups_task.remote(self._key, fn,
+                                       *[parts[i][j]
+                                         for i in builtins.range(len(parts))])
+               for j in builtins.range(n)]
+        return Dataset(out)
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on=None) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on=None) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on=None) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on=None) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on=None, ddof: int = 1) -> Dataset:
+        return self.aggregate(Std(on, ddof))

@@ -189,3 +189,71 @@ def test_arrow_blocks_roundtrip(ray8, tmp_path):
     ds2.write_parquet(str(tmp_path / "pq"))
     back = rd.read_parquet(str(tmp_path / "pq"))
     assert back.count() == 40
+
+
+def test_distributed_sort_many_blocks(ray8):
+    """Sort outputs P globally-ordered blocks — no single-reducer merge
+    (reference: _internal/push_based_shuffle.py + sort.py)."""
+    import random
+
+    vals = list(range(500))
+    random.Random(7).shuffle(vals)
+    ds = rd.from_items(vals, parallelism=8).sort()
+    assert ds.num_blocks() > 1            # NOT one merged block
+    assert ds.take_all() == sorted(vals)
+    ds_desc = rd.from_items(vals, parallelism=8).sort(descending=True)
+    assert ds_desc.take_all() == sorted(vals, reverse=True)
+
+
+def test_sort_by_key_column(ray8):
+    rows = [{"k": i % 13, "v": i} for i in range(200)]
+    out = rd.from_items(rows, parallelism=6).sort(key="k").take_all()
+    assert [r["k"] for r in out] == sorted(r["k"] for r in rows)
+
+
+def test_groupby_aggregate(ray8):
+    rows = [{"g": i % 3, "x": float(i)} for i in range(60)]
+    ds = rd.from_items(rows, parallelism=5)
+    out = ds.groupby("g").sum("x").take_all()
+    got = {r["g"]: r["sum(x)"] for r in out}
+    import collections
+
+    want = collections.defaultdict(float)
+    for r in rows:
+        want[r["g"]] += r["x"]
+    assert got == dict(want)
+    # count + mean via the generic aggregate()
+    out2 = ds.groupby("g").aggregate(rd.Count(), rd.Mean("x")).take_all()
+    for r in out2:
+        assert r["count()"] == 20
+        assert abs(r["mean(x)"] - want[r["g"]] / 20) < 1e-9
+
+
+def test_groupby_map_groups(ray8):
+    rows = [{"g": i % 4, "x": i} for i in range(40)]
+    out = (rd.from_items(rows, parallelism=4)
+           .groupby("g")
+           .map_groups(lambda grp: {"g": grp[0]["g"], "n": len(grp)})
+           .take_all())
+    assert sorted((r["g"], r["n"]) for r in out) == [(i, 10)
+                                                    for i in range(4)]
+
+
+def test_zip(ray8):
+    a = rd.range(50, parallelism=4)
+    b = rd.from_items([i * 10 for i in range(50)], parallelism=3)
+    out = a.zip(b).take_all()
+    assert out == [(i, i * 10) for i in range(50)]
+
+
+def test_dataset_pipeline_window_repeat(ray8):
+    ds = rd.range(40, parallelism=8)
+    pipe = ds.window(blocks_per_window=2).map(lambda x: x * 2)
+    rows = list(pipe.iter_rows())
+    assert sorted(rows) == [x * 2 for x in range(40)]
+    pipe2 = rd.range(10, parallelism=2).repeat(3)
+    assert pipe2.count() == 30
+    shards = rd.range(20, parallelism=4).window(
+        blocks_per_window=2).split(2)
+    total = sum(p.count() for p in shards)
+    assert total == 20
