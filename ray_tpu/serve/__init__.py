@@ -22,6 +22,8 @@ from ray_tpu.serve.api import (
     shutdown,
     start_http_proxy,
 )
+from ray_tpu.serve.batching import batch
 
 __all__ = ["deployment", "Deployment", "DeploymentHandle", "run",
-           "get_deployment_handle", "shutdown", "start_http_proxy"]
+           "get_deployment_handle", "shutdown", "start_http_proxy",
+           "batch"]

@@ -66,6 +66,13 @@ class ServeController:
         # finish (reference: graceful_shutdown_wait_loop_s drain).
         self._draining: List[tuple] = []  # (actor, kill_at_monotonic)
         self._lock = threading.RLock()
+        # Push-based handle updates (reference: _private/long_poll.py:185
+        # LongPollHost): every replica-set mutation bumps the version and
+        # wakes blocked wait_replicas calls; handles hold one such call
+        # open at all times, so scaling/death/drain propagate in one
+        # notify instead of a TTL window.
+        self._replica_version: Dict[str, int] = {}
+        self._version_cv = threading.Condition(self._lock)
         # Serializes whole reconcile ticks: the background loop thread and
         # an actor-method reconcile (deploy/scale) must not both spawn.
         self._reconcile_lock = threading.Lock()
@@ -119,12 +126,19 @@ class ServeController:
     def delete_deployment(self, name: str):
         with self._lock:
             self._deployments.pop(name, None)
-            for r in self._replicas.pop(name, []):
-                try:
-                    ray.kill(r["actor"])
-                except Exception:
-                    pass
+            reps = self._replicas.pop(name, [])
+            self._bump_version_locked(name)
+        for r in reps:
+            try:
+                ray.kill(r["actor"])
+            except Exception:
+                pass
         return True
+
+    def _bump_version_locked(self, name: str):
+        self._replica_version[name] = \
+            self._replica_version.get(name, 0) + 1
+        self._version_cv.notify_all()
 
     def record_handle_metric(self, name: str, handle_id: str, ongoing: int):
         """Handles report their in-flight request count — the autoscaling
@@ -136,7 +150,11 @@ class ServeController:
         return True
 
     def _spawn(self, d: Dict[str, Any], version: int):
-        opts = {"num_cpus": d.get("num_cpus", 1)}
+        # Threaded replicas: concurrent requests are what @serve.batch
+        # coalesces (reference: replicas default to many concurrent
+        # queries, max_concurrent_queries).
+        opts = {"num_cpus": d.get("num_cpus", 1),
+                "max_concurrency": d.get("max_concurrency", 8)}
         if d.get("num_tpus"):
             opts["num_tpus"] = d["num_tpus"]
         remote_cls = ray.remote(ReplicaWrapper)
@@ -232,7 +250,11 @@ class ServeController:
                 self._retire(old)
             with self._lock:
                 if name in self._deployments:
+                    prev_ids = [id(r["actor"])
+                                for r in self._replicas.get(name, [])]
                     self._replicas[name] = alive
+                    if prev_ids != [id(r["actor"]) for r in alive]:
+                        self._bump_version_locked(name)
                     counts[name] = len(alive)
                     continue
             # Deleted mid-tick: nothing tracks these replicas anymore.
@@ -246,6 +268,27 @@ class ServeController:
     def get_replicas(self, name: str):
         with self._lock:
             return [r["actor"] for r in self._replicas.get(name, [])]
+
+    def get_replicas_versioned(self, name: str):
+        with self._lock:
+            return (self._replica_version.get(name, 0),
+                    [r["actor"] for r in self._replicas.get(name, [])])
+
+    def wait_replicas(self, name: str, seen_version: int,
+                      timeout: float = 30.0):
+        """Long-poll: block until the replica set changes past
+        ``seen_version`` (or timeout), then return the fresh set
+        (reference: LongPollHost.listen_for_change,
+        _private/long_poll.py:185)."""
+        deadline = time.monotonic() + timeout
+        with self._version_cv:
+            while self._replica_version.get(name, 0) <= seen_version:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._version_cv.wait(left)
+            return (self._replica_version.get(name, 0),
+                    [r["actor"] for r in self._replicas.get(name, [])])
 
     def num_replicas(self, name: str) -> int:
         with self._lock:
@@ -270,16 +313,18 @@ class ServeController:
 
 
 class DeploymentHandle:
-    """Round-robin router over replicas (reference:
-    _private/router.py:262 ReplicaSet / handle API).
+    """Router over replicas (reference: _private/router.py:262
+    ReplicaSet / handle API).
 
-    The replica set is re-fetched from the controller on a short TTL (the
-    reference pushes updates via LongPollClient, _private/long_poll.py:68 —
-    TTL polling is the condensation) so scaling and dead-replica
-    replacement propagate to existing handles.
+    Replica-set changes arrive by PUSH: a background long-poll thread
+    keeps one blocking ``wait_replicas`` call open at the controller
+    (reference: LongPollClient, _private/long_poll.py:68), so a
+    downscaled/drained replica stops receiving traffic the moment the
+    controller retires it — no TTL window.  Routing is least-loaded
+    power-of-two-choices over the handle's in-flight counts (reference:
+    the queue-length-aware replica scheduler in _private/router.py).
     """
 
-    _TTL = 2.0
     _METRIC_PERIOD = 0.5
 
     def __init__(self, name: str, controller):
@@ -288,74 +333,124 @@ class DeploymentHandle:
         self._name = name
         self._controller = controller
         self._replicas: List[Any] = []
-        self._fetched_at = 0.0
+        self._version = -1
         self._rr = itertools.count()
         self._lock = threading.Lock()
         # Autoscaling signal: outstanding request refs this handle issued;
         # pruned on each call and reported to the controller (reference:
         # handle-side num_queued/ongoing metrics feeding
-        # autoscaling_policy.py).
+        # autoscaling_policy.py).  Entries are (weakref, replica_key) so
+        # the same prune also yields per-replica queue depths for
+        # least-loaded routing.
         self._handle_id = os.urandom(4).hex()
-        self._outstanding: List[Any] = []
+        self._outstanding: List[tuple] = []
+        self._inflight: Dict[int, int] = {}  # replica key -> est. depth
         self._last_report = 0.0
         self._refresh()
+        self._poller = threading.Thread(
+            target=self._long_poll_loop, daemon=True,
+            name=f"serve-handle-{name}")
+        self._poller.start()
 
     def _refresh(self):
-        self._replicas = ray.get(
-            self._controller.get_replicas.remote(self._name))
-        self._fetched_at = time.monotonic()
+        ver, reps = ray.get(
+            self._controller.get_replicas_versioned.remote(self._name))
+        with self._lock:
+            self._version = ver
+            self._replicas = reps
+
+    def _long_poll_loop(self):
+        while True:
+            try:
+                ver, reps = ray.get(
+                    self._controller.wait_replicas.remote(
+                        self._name, self._version, 30.0),
+                    timeout=40.0)
+            except Exception:
+                time.sleep(1.0)
+                continue
+            with self._lock:
+                if ver > self._version:
+                    self._version = ver
+                    self._replicas = reps
 
     def _pick(self):
+        import random
+
         with self._lock:
-            if not self._replicas or                     time.monotonic() - self._fetched_at > self._TTL:
-                self._refresh()
+            if not self._replicas:
+                pass  # fall through to the blocking refresh below
+            else:
+                reps = self._replicas
+                if len(reps) == 1:
+                    return reps[0]
+                # Power-of-two-choices on estimated queue depth; round-
+                # robin supplies the randomness floor.
+                i = next(self._rr) % len(reps)
+                j = random.randrange(len(reps))
+                a, b = reps[i], reps[j]
+                if self._inflight.get(id(b), 0) < \
+                        self._inflight.get(id(a), 0):
+                    return b
+                return a
+        self._refresh()
+        with self._lock:
             if not self._replicas:
                 raise RuntimeError(
                     f"deployment {self._name} has no replicas")
             return self._replicas[next(self._rr) % len(self._replicas)]
 
-    def _track(self, ref):
+    def _track(self, ref, replica):
         import weakref
 
+        rkey = id(replica)
         now = time.monotonic()
         with self._lock:
             # Weak refs: the handle must never pin result objects — an
             # idle handle after a burst would otherwise hold the last
             # batch's outputs alive in the object store forever.
-            self._outstanding.append(weakref.ref(ref))
+            self._outstanding.append((weakref.ref(ref), rkey))
+            self._inflight[rkey] = self._inflight.get(rkey, 0) + 1
             if now - self._last_report < self._METRIC_PERIOD:
                 return ref
             self._last_report = now
-            live = [w() for w in self._outstanding]
-            live = [r for r in live if r is not None]
+            live = [(w(), k) for w, k in self._outstanding]
+            live = [(r, k) for r, k in live if r is not None]
             if live:
                 import ray_tpu as _ray
 
                 done, pending = _ray.wait(
-                    live, num_returns=len(live), timeout=0)
+                    [r for r, _ in live], num_returns=len(live), timeout=0)
                 pend_set = {r.id() for r in pending}
                 self._outstanding = [
-                    w for w in self._outstanding
+                    (w, k) for w, k in self._outstanding
                     if (r := w()) is not None and r.id() in pend_set]
-                ongoing = len(pending)
+                ongoing = len(self._outstanding)
             else:
                 self._outstanding = []
                 ongoing = 0
+            counts: Dict[int, int] = {}
+            for _w, k in self._outstanding:
+                counts[k] = counts.get(k, 0) + 1
+            self._inflight = counts
         # Fire-and-forget: the metric must never block the data path.
         self._controller.record_handle_metric.remote(
             self._name, self._handle_id, ongoing)
         return ref
 
     def remote(self, *args, **kwargs):
-        return self._track(self._pick().handle_request.remote(args, kwargs))
+        replica = self._pick()
+        return self._track(replica.handle_request.remote(args, kwargs),
+                           replica)
 
     def method(self, method_name: str):
         handle = self
 
         class _M:
             def remote(self, *args, **kwargs):
-                return handle._pick().call_method.remote(
-                    method_name, args, kwargs)
+                replica = handle._pick()
+                return handle._track(replica.call_method.remote(
+                    method_name, args, kwargs), replica)
 
         return _M()
 
@@ -422,7 +517,7 @@ _state: Dict[str, Any] = {"controller": None, "proxy": None,
 def _get_controller():
     if _state["controller"] is None:
         _state["controller"] = ServeController.options(
-            name=CONTROLLER_NAME).remote()
+            name=CONTROLLER_NAME, max_concurrency=64).remote()
     return _state["controller"]
 
 

@@ -1,0 +1,121 @@
+"""Request batching inside replicas.
+
+Reference: ``python/ray/serve/batching.py`` — ``@serve.batch`` collects
+concurrent calls into one invocation of the wrapped function, which
+receives a LIST of the single-call arguments and returns a list of
+results (positional).  The reference batches on the replica's asyncio
+loop; our replicas are threaded (``max_concurrency``), so batching
+rendezvouses caller threads: the first caller of a batch becomes the
+leader, waits up to ``batch_wait_timeout_s`` for followers (or until
+``max_batch_size``), runs the underlying function once, and distributes
+results.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, List, Optional
+
+
+class _Entry:
+    __slots__ = ("item", "event", "result", "error")
+
+    def __init__(self, item):
+        self.item = item
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _Batcher:
+    def __init__(self, fn: Callable, instance, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._instance = instance
+        self._max = max_batch_size
+        self._timeout = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._pending: List[_Entry] = []
+        self._full = threading.Event()
+
+    def submit(self, item):
+        entry = _Entry(item)
+        with self._lock:
+            self._pending.append(entry)
+            leader = len(self._pending) == 1
+            if len(self._pending) >= self._max:
+                self._full.set()
+        if leader:
+            self._full.wait(self._timeout)
+            with self._lock:
+                batch, self._pending = self._pending, []
+                self._full.clear()
+            self._run(batch)
+        else:
+            entry.event.wait()
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    def _run(self, batch: List[_Entry]):
+        try:
+            items = [e.item for e in batch]
+            if self._instance is not None:
+                results = self._fn(self._instance, items)
+            else:
+                results = self._fn(items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} "
+                    f"results for {len(items)} inputs")
+            for e, r in zip(batch, results):
+                e.result = r
+        except BaseException as err:  # noqa: BLE001 — fan the error out
+            for e in batch:
+                e.error = err
+        finally:
+            for e in batch:
+                e.event.set()
+
+
+def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: the wrapped fn must take a LIST of requests and return
+    a list of results.  Callers still pass a single request each
+    (reference: serve/batching.py @serve.batch)."""
+
+    def deco(fn):
+        # No lock/batcher captured in the closure: the deployment class
+        # (and this wrapper with it) crosses the wire via cloudpickle,
+        # and thread locks don't pickle.  The batcher attaches to the
+        # replica-side instance (or the wrapper itself for plain
+        # functions) on first call.
+        attr = f"__serve_batcher_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:
+                instance, item = args
+            elif len(args) == 1:
+                instance, item = None, args[0]
+            else:
+                raise TypeError(
+                    "@serve.batch methods take exactly one request "
+                    "argument")
+            holder = instance if instance is not None else wrapper
+            b = getattr(holder, attr, None)
+            if b is None:
+                # GIL-atomic setdefault: a racing thread's extra
+                # _Batcher is discarded, the winner is shared.
+                b = holder.__dict__.setdefault(
+                    attr, _Batcher(fn, instance, max_batch_size,
+                                   batch_wait_timeout_s))
+            return b.submit(item)
+
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    if _func is not None:
+        return deco(_func)
+    return deco
