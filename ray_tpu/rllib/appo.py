@@ -1,0 +1,182 @@
+"""APPO: asynchronous PPO on the IMPALA actor-learner skeleton.
+
+Reference: ``rllib/algorithms/appo/appo.py`` — IMPALA's asynchronous
+sampling pipeline, but the learner optimizes the PPO clipped surrogate
+on V-trace-corrected advantages against a periodically-synced TARGET
+policy (the reference updates it every ``target_update_frequency``
+learner steps).  Staleness robustness comes from both mechanisms:
+V-trace reweights old trajectories; the clip bounds the update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu as ray
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.impala import Impala, ImpalaConfig
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.models import ActorCriticMLP
+from ray_tpu.rllib.rollout_worker import WorkerSet
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, LOGP, OBS, REWARDS,
+)
+from ray_tpu.rllib.vtrace import vtrace
+
+
+def appo_loss(params, module, batch, *, gamma: float, clip_param: float,
+              vf_coef: float, ent_coef: float, clip_rho: float,
+              clip_c: float):
+    """PPO clipped surrogate on V-trace advantages computed from the
+    TARGET policy's values (rider in batch as 'target_logp'/'target_vs'
+    precomputation happens learner-side for one jitted program)."""
+    t, b = batch[ACTIONS].shape
+    obs = batch[OBS].reshape(t * b, -1)
+    logits, values = module.apply(params, obs)
+    logits = logits.reshape(t, b, -1)
+    values = values.reshape(t, b)
+    logp_all = jax.nn.log_softmax(logits)
+    cur_logp = jnp.take_along_axis(
+        logp_all, batch[ACTIONS][..., None].astype(jnp.int32), -1)[..., 0]
+    _, bootstrap = module.apply(params, batch["bootstrap_obs"])
+    discounts = gamma * (1.0 - batch[DONES].astype(jnp.float32))
+    # V-trace targets/advantages from the TARGET policy's logp (stop-
+    # gradient semantics: target params produced these outside the jit).
+    vt = vtrace(batch[LOGP], batch["target_logp"], batch[REWARDS],
+                batch["target_values"], batch["target_bootstrap"],
+                discounts, clip_rho, clip_c)
+    ratio = jnp.exp(cur_logp - batch[LOGP])
+    adv = vt.pg_advantages
+    surrogate = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+    pi_loss = -jnp.mean(surrogate)
+    vf_loss = jnp.mean((values - vt.vs) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    loss = pi_loss + vf_coef * vf_loss - ent_coef * entropy
+    return loss, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                  "entropy": entropy,
+                  "mean_ratio": jnp.mean(ratio)}
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.3
+        self.target_update_frequency = 4  # learner updates per sync
+
+    @property
+    def algo_class(self):
+        return APPO
+
+
+class APPO(Impala):
+    """reference: appo.py:51 APPO(Impala)."""
+
+    config_class = APPOConfig
+
+    def _setup(self, cfg: APPOConfig):
+        env = cfg.env_maker()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        if hasattr(env, "close"):
+            env.close()
+        model_config = {"obs_dim": obs_dim, "num_actions": num_actions,
+                        "hidden": tuple(cfg.model.get("hidden", (64, 64)))}
+        self._obs_dim = obs_dim
+        self.workers = WorkerSet(
+            cfg.env_maker, model_config, cfg.num_rollout_workers,
+            cfg.num_envs_per_worker, gamma=cfg.gamma)
+        module = ActorCriticMLP(**model_config)
+        self._module = module
+
+        def loss(params, mod, batch):
+            return appo_loss(params, mod, batch, gamma=cfg.gamma,
+                             clip_param=cfg.clip_param,
+                             vf_coef=cfg.vf_loss_coeff,
+                             ent_coef=cfg.entropy_coeff,
+                             clip_rho=cfg.clip_rho_threshold,
+                             clip_c=cfg.clip_c_threshold)
+
+        self.learner_group = LearnerGroup(lambda: Learner(
+            module, loss, optimizer=optax.chain(
+                optax.clip_by_global_norm(cfg.grad_clip),
+                optax.adam(cfg.lr)), seed=cfg.seed))
+        self._target_params = jax.tree.map(
+            jnp.copy, self.learner_group.get_weights())
+        self._updates_since_target_sync = 0
+
+        def target_fwd(params, obs_flat, actions, bootstrap_obs):
+            logits, values = module.apply(params, obs_flat)
+            logp_all = jax.nn.log_softmax(logits)
+            tl = jnp.take_along_axis(
+                logp_all, actions[..., None].astype(jnp.int32), -1)[..., 0]
+            _, bs = module.apply(params, bootstrap_obs)
+            return tl, values, bs
+
+        self._target_fwd = jax.jit(target_fwd)
+        w = self.learner_group.get_weights()
+        self.workers.sync_weights(w)
+        self._inflight = {
+            worker.sample.remote(cfg.rollout_fragment_length): i
+            for i, worker in enumerate(self.workers.workers)}
+
+    def _augment_with_target(self, tm: Dict[str, Any]) -> Dict[str, Any]:
+        t, b = tm[ACTIONS].shape
+        obs = jnp.asarray(tm[OBS].reshape(t * b, -1))
+        tl, tv, bs = self._target_fwd(
+            self._target_params, obs,
+            jnp.asarray(tm[ACTIONS].reshape(t * b)),
+            jnp.asarray(tm["bootstrap_obs"]))
+        tm["target_logp"] = np.asarray(tl).reshape(t, b)
+        tm["target_values"] = np.asarray(tv).reshape(t, b)
+        tm["target_bootstrap"] = np.asarray(bs)
+        return tm
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: APPOConfig = self.algo_config
+        from ray_tpu.rllib.sample_batch import SampleBatch
+
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        processed = 0
+        while processed < cfg.max_batches_per_step and self._inflight:
+            done, _ = ray.wait(list(self._inflight), num_returns=1,
+                               timeout=30.0)
+            if not done:
+                break
+            fut = done[0]
+            idx = self._inflight.pop(fut)
+            worker = self.workers.workers[idx]
+            try:
+                flat = ray.get(fut)
+            except Exception:
+                worker = self.workers.recreate(idx)
+                worker.set_weights.remote(self.learner_group.get_weights())
+                self._inflight[worker.sample.remote(
+                    cfg.rollout_fragment_length)] = idx
+                continue
+            tm = self._to_time_major(flat, cfg.rollout_fragment_length)
+            tm = self._augment_with_target(tm)
+            metrics = self.learner_group.update(SampleBatch(tm))
+            steps += len(flat)
+            processed += 1
+            self._updates_since_target_sync += 1
+            if self._updates_since_target_sync >= \
+                    cfg.target_update_frequency:
+                self._target_params = jax.tree.map(
+                    jnp.copy, self.learner_group.get_weights())
+                self._updates_since_target_sync = 0
+            worker.set_weights.remote(self.learner_group.get_weights())
+            self._inflight[worker.sample.remote(
+                cfg.rollout_fragment_length)] = idx
+        returns = self.workers.episode_returns()
+        if returns:
+            metrics["episode_reward_mean"] = float(np.mean(returns))
+        metrics["num_env_steps_sampled"] = steps
+        return metrics

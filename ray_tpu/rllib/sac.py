@@ -1,0 +1,361 @@
+"""SAC: off-policy maximum-entropy RL for continuous actions.
+
+Reference: ``rllib/algorithms/sac/sac.py`` + ``sac_torch_policy.py`` —
+twin Q critics with polyak-averaged targets, a tanh-squashed Gaussian
+policy trained by the reparameterization trick, and a learned entropy
+temperature alpha against a target entropy of ``-act_dim``.  The TPU
+split matches DQN here: CPU rollout workers act stochastically and push
+transitions to the ReplayActor; the whole update (both critics, actor,
+alpha, polyak) is ONE jitted program on the device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu as ray
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import VectorEnv
+from ray_tpu.rllib.replay_buffers import BATCH_INDEXES, ReplayActor
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS,
+)
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for a, b in zip(sizes[:-1], sizes[1:]):
+        key, k = jax.random.split(key)
+        params.append({"w": jax.random.normal(k, (a, b))
+                       * np.sqrt(2.0 / a),
+                       "b": jnp.zeros((b,))})
+    return params
+
+
+def _mlp_apply(params, x, final_tanh=False):
+    for i, lyr in enumerate(params):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class SquashedGaussianPolicy:
+    """obs -> (mu, log_std); actions tanh-squashed into [low, high]
+    (reference: SquashedGaussian distribution in rllib/models)."""
+
+    def __init__(self, obs_dim: int, act_dim: int, low, high,
+                 hidden=(64, 64)):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hidden = tuple(hidden)
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def init(self, key):
+        return _mlp_init(key, (self.obs_dim,) + self.hidden
+                         + (2 * self.act_dim,))
+
+    def sample(self, params, obs, key):
+        """Reparameterized (action, logp) with the tanh change-of-
+        variables correction."""
+        out = _mlp_apply(params, obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre = mu + std * eps
+        # N(mu, std) log-density of pre
+        logp = jnp.sum(
+            -0.5 * ((pre - mu) / std) ** 2 - log_std
+            - 0.5 * np.log(2 * np.pi), axis=-1)
+        # tanh squash correction: log det |d tanh / dx| summed over dims
+        logp -= jnp.sum(2.0 * (np.log(2.0) - pre
+                               - jax.nn.softplus(-2.0 * pre)), axis=-1)
+        squashed = jnp.tanh(pre)
+        scale = (self.high - self.low) / 2.0
+        mid = (self.high + self.low) / 2.0
+        action = squashed * scale + mid
+        # affine-rescale log-det (constant; keeps alpha's entropy target
+        # in the true action measure)
+        logp -= float(np.sum(np.log(scale + 1e-8)))
+        return action, logp
+
+
+class QNetwork:
+    def __init__(self, obs_dim: int, act_dim: int, hidden=(64, 64)):
+        self.sizes = (obs_dim + act_dim,) + tuple(hidden) + (1,)
+
+    def init(self, key):
+        return _mlp_init(key, self.sizes)
+
+    def apply(self, params, obs, act):
+        return _mlp_apply(params, jnp.concatenate([obs, act], -1))[..., 0]
+
+
+@ray.remote
+class SACRolloutWorker:
+    """Stochastic continuous-action rollouts -> replay (reference:
+    SAC's default sample collection; exploration IS the policy)."""
+
+    def __init__(self, env_maker, policy_config: Dict[str, Any],
+                 replay_actor, num_envs: int = 1, worker_index: int = 0,
+                 warmup_uniform_steps: int = 500):
+        self._venv = VectorEnv(env_maker, num_envs, seed=worker_index)
+        self._policy = SquashedGaussianPolicy(**policy_config)
+        self._params = None
+        self._replay = replay_actor
+        self._key = jax.random.PRNGKey(worker_index)
+        self._obs = self._venv.vector_reset()
+        self._sample = jax.jit(self._policy.sample)
+        self._ep_returns = np.zeros(num_envs)
+        self._completed: List[float] = []
+        self._steps = 0
+        self._warmup = warmup_uniform_steps
+        self._rng = np.random.default_rng(worker_index)
+
+    def set_weights(self, weights):
+        self._params = jax.device_put(weights)
+        return True
+
+    def sample(self, num_steps: int) -> int:
+        n = self._venv.num_envs
+        cols = {k: [] for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, DONES)}
+        for _ in range(max(1, num_steps // n)):
+            if self._steps < self._warmup or self._params is None:
+                act = self._rng.uniform(
+                    self._policy.low, self._policy.high,
+                    size=(n, self._policy.act_dim)).astype(np.float32)
+            else:
+                self._key, k = jax.random.split(self._key)
+                act, _ = self._sample(self._params,
+                                      jnp.asarray(self._obs), k)
+                act = np.asarray(act)
+            next_obs, rews, terms, truncs, finals, _ = \
+                self._venv.vector_step(act)
+            cols[OBS].append(self._obs.copy())
+            cols[ACTIONS].append(act)
+            cols[REWARDS].append(rews)
+            cols[NEXT_OBS].append(finals)  # pre-reset obs for bootstrap
+            # DONES carries TERMINATION only: truncation still bootstraps
+            # (time-limit bias; same convention as the DQN worker).
+            cols[DONES].append(terms.astype(np.float32))
+            self._ep_returns += rews
+            for i in np.nonzero(terms | truncs)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            self._obs = next_obs
+            self._steps += n
+        ray.get(self._replay.add.remote({
+            OBS: np.concatenate(cols[OBS]).astype(np.float32),
+            ACTIONS: np.concatenate(cols[ACTIONS]).astype(np.float32),
+            REWARDS: np.concatenate(cols[REWARDS]).astype(np.float32),
+            NEXT_OBS: np.concatenate(cols[NEXT_OBS]).astype(np.float32),
+            DONES: np.concatenate(cols[DONES]).astype(np.float32)}))
+        return max(1, num_steps // n) * n
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._completed)
+        if clear:
+            self._completed = []
+        return out
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.tau = 0.005
+        self.target_entropy = None  # default: -act_dim
+        self.num_steps_sampled_before_learning = 600
+        self.num_train_batches_per_step = 32
+        self.warmup_uniform_steps = 600
+        self.grad_clip = 40.0
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def _setup(self, cfg: SACConfig):
+        env = cfg.env_maker()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_space = env.action_space
+        act_dim = int(np.prod(act_space.shape))
+        low, high = act_space.low, act_space.high
+        if hasattr(env, "close"):
+            env.close()
+        hidden = tuple(cfg.model.get("hidden", (64, 64)))
+        policy_config = {"obs_dim": obs_dim, "act_dim": act_dim,
+                         "low": low, "high": high, "hidden": hidden}
+        self.policy = SquashedGaussianPolicy(**policy_config)
+        self.q = QNetwork(obs_dim, act_dim, hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        kp, k1, k2 = jax.random.split(key, 3)
+        self.pi_params = self.policy.init(kp)
+        self.q_params = {"q1": self.q.init(k1), "q2": self.q.init(k2)}
+        self.q_target = jax.tree.map(jnp.copy, self.q_params)
+        self.log_alpha = jnp.zeros(())
+        target_entropy = (cfg.target_entropy
+                          if cfg.target_entropy is not None
+                          else -float(act_dim))
+
+        self._pi_opt = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip), optax.adam(cfg.lr))
+        self._q_opt = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.critic_lr))
+        self._a_opt = optax.adam(cfg.alpha_lr)
+        self._pi_state = self._pi_opt.init(self.pi_params)
+        self._q_state = self._q_opt.init(self.q_params)
+        self._a_state = self._a_opt.init(self.log_alpha)
+
+        policy, q = self.policy, self.q
+        tau, gamma = cfg.tau, cfg.gamma
+
+        def critic_loss(q_params, pi_params, q_target, log_alpha, batch,
+                        key):
+            next_a, next_logp = policy.sample(pi_params, batch[NEXT_OBS],
+                                              key)
+            tq1 = q.apply(q_target["q1"], batch[NEXT_OBS], next_a)
+            tq2 = q.apply(q_target["q2"], batch[NEXT_OBS], next_a)
+            alpha = jnp.exp(log_alpha)
+            next_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = batch[REWARDS] + gamma * (1 - batch[DONES]) * next_v
+            target = jax.lax.stop_gradient(target)
+            q1 = q.apply(q_params["q1"], batch[OBS], batch[ACTIONS])
+            q2 = q.apply(q_params["q2"], batch[OBS], batch[ACTIONS])
+            return (jnp.mean((q1 - target) ** 2)
+                    + jnp.mean((q2 - target) ** 2)), jnp.mean(q1)
+
+        def actor_loss(pi_params, q_params, log_alpha, batch, key):
+            a, logp = policy.sample(pi_params, batch[OBS], key)
+            q1 = q.apply(q_params["q1"], batch[OBS], a)
+            q2 = q.apply(q_params["q2"], batch[OBS], a)
+            alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        def alpha_loss(log_alpha, logp):
+            return -jnp.mean(log_alpha
+                             * jax.lax.stop_gradient(logp
+                                                     + target_entropy))
+
+        def update(pi_params, q_params, q_target, log_alpha,
+                   pi_state, q_state, a_state, batch, key):
+            kc, ka = jax.random.split(key)
+            (closs, mean_q), qg = jax.value_and_grad(
+                critic_loss, has_aux=True)(q_params, pi_params, q_target,
+                                           log_alpha, batch, kc)
+            qup, q_state = self._q_opt.update(qg, q_state, q_params)
+            q_params = optax.apply_updates(q_params, qup)
+            (aloss, logp), pg = jax.value_and_grad(
+                actor_loss, has_aux=True)(pi_params, q_params, log_alpha,
+                                          batch, ka)
+            pup, pi_state = self._pi_opt.update(pg, pi_state, pi_params)
+            pi_params = optax.apply_updates(pi_params, pup)
+            lloss, lg = jax.value_and_grad(alpha_loss)(log_alpha, logp)
+            lup, a_state = self._a_opt.update(lg, a_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, lup)
+            q_target = jax.tree.map(
+                lambda t, s: (1 - tau) * t + tau * s, q_target, q_params)
+            return (pi_params, q_params, q_target, log_alpha, pi_state,
+                    q_state, a_state,
+                    {"critic_loss": closs, "actor_loss": aloss,
+                     "alpha": jnp.exp(log_alpha), "mean_q": mean_q,
+                     "entropy": -jnp.mean(logp)})
+
+        self._update = jax.jit(update, donate_argnums=(0, 1, 2, 3, 4,
+                                                       5, 6))
+        self._key = jax.random.PRNGKey(cfg.seed + 1)
+
+        self.replay = ReplayActor.options(num_cpus=1).remote(
+            capacity=cfg.replay_buffer_capacity, prioritized=False,
+            seed=cfg.seed)
+        self.workers = [
+            SACRolloutWorker.options(num_cpus=1).remote(
+                cfg.env_maker, policy_config, self.replay,
+                num_envs=cfg.num_envs_per_worker, worker_index=i,
+                warmup_uniform_steps=cfg.warmup_uniform_steps)
+            for i in range(cfg.num_rollout_workers)]
+        self._steps_sampled = 0
+        self._sync_worker_weights()
+
+    def _sync_worker_weights(self):
+        w = jax.device_get(self.pi_params)
+        ray.get([wk.set_weights.remote(w) for wk in self.workers])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: SACConfig = self.algo_config
+        steps = ray.get([w.sample.remote(cfg.rollout_fragment_length)
+                         for w in self.workers])
+        self._steps_sampled += sum(steps)
+        metrics: Dict[str, Any] = {
+            "num_env_steps_sampled": self._steps_sampled}
+        if self._steps_sampled >= cfg.num_steps_sampled_before_learning:
+            aux = None
+            for _ in range(cfg.num_train_batches_per_step):
+                raw = ray.get(self.replay.sample.remote(
+                    cfg.train_batch_size, 0.0))
+                if raw is None:
+                    break
+                batch = {k: jnp.asarray(v) for k, v in raw.items()
+                         if k != BATCH_INDEXES and k != "weights"}
+                self._key, k = jax.random.split(self._key)
+                (self.pi_params, self.q_params, self.q_target,
+                 self.log_alpha, self._pi_state, self._q_state,
+                 self._a_state, aux) = self._update(
+                    self.pi_params, self.q_params, self.q_target,
+                    self.log_alpha, self._pi_state, self._q_state,
+                    self._a_state, batch, k)
+            if aux is not None:
+                metrics.update({k: float(v) for k, v in aux.items()})
+            self._sync_worker_weights()
+        returns = []
+        for w in self.workers:
+            try:
+                returns.extend(ray.get(w.episode_returns.remote()))
+            except Exception:
+                pass
+        if returns:
+            metrics["episode_reward_mean"] = float(np.mean(returns))
+        return metrics
+
+    def save_checkpoint(self):
+        return {"pi": jax.device_get(self.pi_params),
+                "q": jax.device_get(self.q_params),
+                "qt": jax.device_get(self.q_target),
+                "log_alpha": jax.device_get(self.log_alpha),
+                "steps": self._steps_sampled}
+
+    def load_checkpoint(self, state):
+        self.pi_params = jax.device_put(state["pi"])
+        self.q_params = jax.device_put(state["q"])
+        self.q_target = jax.device_put(state["qt"])
+        self.log_alpha = jax.device_put(state["log_alpha"])
+        self._steps_sampled = state.get("steps", 0)
+        self._sync_worker_weights()
+
+    def cleanup(self):
+        for w in self.workers:
+            try:
+                ray.kill(w)
+            except Exception:
+                pass
+        try:
+            ray.kill(self.replay)
+        except Exception:
+            pass
