@@ -25,6 +25,8 @@ BASELINE = {
     "multi_client_tasks_async": 28385.0,
     "one_one_actor_calls_sync": 2142.0,
     "one_one_actor_calls_async": 8099.0,
+    "one_one_actor_calls_concurrent": 4928.0,
+    "one_one_async_actor_calls_sync": 1559.0,
     "one_n_actor_calls_async": 10962.0,
     "n_n_actor_calls_async": 32387.0,
     "single_client_get_calls": 5902.0,
@@ -33,6 +35,12 @@ BASELINE = {
     "single_client_wait_1k_refs": 5.45,
     "single_client_get_object_containing_10k_refs": 13.3,
 }
+
+# Not folded into the headline geomean: the reference's get_calls number
+# measures plasma-store gets through a store RPC, while ours are in-process
+# zero-copy mmap attaches — a structurally different (and much faster)
+# operation, so the ratio would flatter the geomean apples-to-oranges.
+NON_COMPARABLE = {"single_client_get_calls"}
 
 
 def timeit(fn, n, warmup=50):
@@ -46,8 +54,10 @@ def core_bench():
     import numpy as np
 
     import ray_tpu as ray
-    # 8 worker-pool CPUs for tasks + client/server actors below.
-    ray.init(num_cpus=24)
+    # Actors below hold 19 CPU slots; the rest are worker-pool slots for
+    # task leases (the reference harness runs on a 64-vCPU box with the
+    # full core count available).
+    ray.init(num_cpus=32)
 
     @ray.remote
     def f():
@@ -75,7 +85,13 @@ def core_bench():
             import numpy as np
 
             import ray_tpu as ray
-            a = np.zeros(nbytes, dtype=np.uint8)
+            # Source array allocated once per client and kept warm across
+            # calls (ray_perf.py's multi-client put loop reuses one warm
+            # buffer per client; a cold np.zeros would measure the
+            # kernel's zero-page faulting, not the store).
+            a = getattr(self, "_buf", None)
+            if a is None or len(a) != nbytes:
+                a = self._buf = np.ones(nbytes, dtype=np.uint8)
             for _ in range(reps):
                 ray.put(a)
 
@@ -113,6 +129,35 @@ def core_bench():
         ray.get([a.m.remote() for _ in range(n)])
 
     results["one_one_actor_calls_async"] = timeit(actor_async, 3000)
+
+    @ray.remote
+    class ThreadedActor:
+        def m(self):
+            return None
+
+    ta = ThreadedActor.options(max_concurrency=4).remote()
+    ray.get(ta.m.remote())
+
+    def actor_concurrent(n):
+        ray.get([ta.m.remote() for _ in range(n)])
+
+    results["one_one_actor_calls_concurrent"] = timeit(actor_concurrent,
+                                                       2000)
+
+    @ray.remote
+    class AsyncActor:
+        async def m(self):
+            return None
+
+    aa = AsyncActor.remote()
+    ray.get(aa.m.remote())
+
+    def async_actor_sync(n):
+        for _ in range(n):
+            ray.get(aa.m.remote())
+
+    results["one_one_async_actor_calls_sync"] = timeit(async_actor_sync,
+                                                       800)
 
     actors = [Actor.remote() for _ in range(8)]
     ray.get([b.m.remote() for b in actors])
@@ -170,17 +215,29 @@ def core_bench():
 
     results["single_client_wait_1k_refs"] = timeit(wait_1k, 8, 1)
 
-    def get_10k_container(n):
-        for _ in range(n):
-            inner = [ray.put(b"x") for _ in range(10000)]
-            box = ray.put(inner)
-            got = ray.get(box)
-            assert len(got) == 10000
+    # Baseline semantics (ray_perf.py): a task builds the container once
+    # outside the timed region; the metric is gets/s of an object whose
+    # payload is 10k ObjectRefs (deserialize + register + drop 10k refs
+    # per get).  Distinct worker-created containers per iteration so the
+    # driver's value cache can't short-circuit deserialization.
+    @ray.remote
+    def make_box():
+        import ray_tpu as ray
+        return [ray.put(b"x") for _ in range(10000)]
 
-    # Baseline counts only the container-get; ours includes building it,
-    # so this under-reports rather than cheats.
-    results["single_client_get_object_containing_10k_refs"] = timeit(
-        get_10k_container, 4, 1)
+    K = 6
+    boxes = [make_box.remote() for _ in range(K)]
+    got = ray.get(boxes[0])  # warm
+    assert len(got) == 10000
+    del got
+    t0 = time.perf_counter()
+    for box in boxes[1:]:
+        got = ray.get(box)
+        assert len(got) == 10000
+        del got
+    results["single_client_get_object_containing_10k_refs"] = (
+        (K - 1) / (time.perf_counter() - t0))
+    del boxes
 
     ray.shutdown()
     return results
@@ -338,10 +395,18 @@ def main():
     results = core_bench()
 
     ratios = []
+    extras = {}
     for k, v in results.items():
         r = v / BASELINE[k]
-        ratios.append(r)
-        print(f"  {k}: {v:.1f} (ref {BASELINE[k]:.1f}, {r:.2f}x)",
+        tag = ""
+        if k in NON_COMPARABLE:
+            extras[k] = {"value": round(v, 1), "ref": BASELINE[k],
+                         "ratio": round(r, 2),
+                         "note": "excluded from geomean (not like-for-like)"}
+            tag = "  [excluded from geomean]"
+        else:
+            ratios.append(r)
+        print(f"  {k}: {v:.1f} (ref {BASELINE[k]:.1f}, {r:.2f}x){tag}",
               file=sys.stderr)
     geo = 1.0
     for r in ratios:
@@ -359,6 +424,7 @@ def main():
         "value": round(geo, 4),
         "unit": "x (1.0 = reference-published parity)",
         "vs_baseline": round(geo, 4),
+        "non_comparable": extras,
         "tpu": tpu,
     }))
 

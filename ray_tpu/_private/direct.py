@@ -51,6 +51,8 @@ DELEGATED = 3  # handed to the head (exported or rerouted); head is authority
 PIPELINE_DEPTH = 8       # max unacked pushes per leased worker
 MAX_LEASES_PER_REQ = 8
 LEASE_LINGER_S = 0.2     # idle time before a lease is returned to the head
+REROUTE_CHUNK = 32       # specs sent via the head per failed lease round
+ACTOR_PIPELINE = 64      # max unacked direct pushes per actor channel
 
 
 class OwnedState:
@@ -154,6 +156,19 @@ class DirectCaller:
                 return False
             st.local_refs += 1
             return True
+
+    def addref_batch(self, oids: List[ObjectID]) -> List[bytes]:
+        """Addref every owned oid under ONE lock pass; returns the bins
+        of the foreign (head-owned) ones for the caller to batch-send."""
+        foreign: List[bytes] = []
+        with self.lock:
+            for oid in oids:
+                st = self.owned.get(oid)
+                if st is None:
+                    foreign.append(oid.binary())
+                else:
+                    st.local_refs += 1
+        return foreign
 
     def decref(self, oid: ObjectID) -> bool:
         """True if owned here.  DELEGATED entries forward to the head when
@@ -385,30 +400,48 @@ class DirectCaller:
                     pool["last_req"] = now
                     need_leases = min(MAX_LEASES_PER_REQ,
                                       max(1, len(q) // PIPELINE_DEPTH))
+        by_lease: Dict[int, Tuple[_Lease, list]] = {}
         for lease, entry in to_push:
-            self._push_one(lease, entry)
+            by_lease.setdefault(id(lease), (lease, []))[1].append(entry)
+        for lease, entries in by_lease.values():
+            self._push_group(lease, entries)
         if need_leases:
             threading.Thread(
                 target=self._request_leases, args=(klass, need_leases),
                 daemon=True).start()
 
-    def _push_one(self, lease: _Lease, entry: dict):
-        spec = entry["spec"]
-        try:
-            task = self._build_task(spec)
-        except exc.RayTpuError as e:
+    def _push_group(self, lease: _Lease, entries: List[dict]):
+        """Push a burst of entries to one lease as ONE wire message
+        (``dexec_batch``) — per-task sends made the push path syscall- and
+        pickle-bound under multi-client load (reference: gRPC stream write
+        coalescing on the PushTask stream)."""
+        tasks, failed = [], []
+        for entry in entries:
+            try:
+                tasks.append((entry, self._build_task(entry["spec"])))
+            except exc.RayTpuError as e:
+                failed.append((entry, e))
+        if failed:
             with self.lock:
-                lease.inflight.pop(entry["rid"], None)
-            self._fail_entry(entry, e)
+                for entry, _ in failed:
+                    lease.inflight.pop(entry["rid"], None)
+            for entry, e in failed:
+                self._fail_entry(entry, e)
+        if not tasks:
             return
         try:
-            fid = spec.get("func_id")
-            if fid and fid not in lease.funcs_sent:
-                payload = self.host.get_payload(fid)
-                if payload is not None:
-                    lease.send(("dfunc", fid, payload))
-                lease.funcs_sent.add(fid)
-            lease.send(("dexec", entry["rid"], task))
+            for entry, _task in tasks:
+                fid = entry["spec"].get("func_id")
+                if fid and fid not in lease.funcs_sent:
+                    payload = self.host.get_payload(fid)
+                    if payload is not None:
+                        lease.send(("dfunc", fid, payload))
+                    lease.funcs_sent.add(fid)
+            if len(tasks) == 1:
+                lease.send(("dexec", tasks[0][0]["rid"], tasks[0][1]))
+            else:
+                lease.send(("dexec_batch",
+                            [(e["rid"], t) for e, t in tasks]))
         except Exception:
             self._on_lease_dead(lease)
 
@@ -569,7 +602,11 @@ class DirectCaller:
             elif ch["state"] == "direct":
                 lease = ch["lease"]
                 q = ch["queue"]
-                while q and q[0]["deps"] == 0:
+                # Bounded pipeline: beyond ACTOR_PIPELINE unacked pushes,
+                # calls wait here and ride out in result-clocked batches —
+                # unbounded per-call sends made the channel syscall-bound.
+                while q and q[0]["deps"] == 0 \
+                        and len(lease.inflight) < ACTOR_PIPELINE:
                     entry = q.popleft()
                     rid = next(self.rid_counter)
                     entry["rid"] = rid
@@ -577,14 +614,27 @@ class DirectCaller:
                     to_push.append((lease, entry))
         for entry in to_head:
             self._reroute_to_head(entry)
-        for lease, entry in to_push:
-            self._push_one(lease, entry)
+        if to_push:
+            self._push_group(to_push[0][0], [e for _, e in to_push])
 
     def _pump_any(self, klass):
         if klass and klass[0] == "actor":
             self._pump_actor(klass[1])
         else:
             self._pump(klass)
+
+    def actor_channel_busy(self, aid: bytes) -> bool:
+        """True while this process still has queued or unacked direct
+        calls to the actor (the worker holds its actor-handle decrefs
+        until then — the head cannot see direct pushes)."""
+        with self.lock:
+            ch = self.actor_channels.get(aid)
+            if ch is None:
+                return False
+            if ch["queue"]:
+                return True
+            lease = ch.get("lease")
+            return lease is not None and bool(lease.inflight)
 
     def _on_actor_channel_dead(self, lease: _Lease, aid: bytes):
         """Actor worker conn broke: already-pushed calls may have run, so
@@ -642,13 +692,18 @@ class DirectCaller:
             pool["requesting"] = False
             for lease in granted:
                 pool["leases"].append(lease)
-            if not granted and pool["queue"]:
-                # Starved: reroute everything queued through the head so
-                # progress never depends on lease availability.
-                stranded = list(pool["queue"])
-                pool["queue"].clear()
-            else:
-                stranded = []
+            stranded = []
+            if not granted and pool["queue"] and not pool["leases"]:
+                # Starved even after the head parked the request: route a
+                # BOUNDED chunk through the head (progress guarantee) and
+                # keep the rest queued for the next lease request — the
+                # v1 full-queue dump made every concurrent caller collapse
+                # onto the head's single mailbox the moment leases
+                # momentarily ran out.
+                for _ in range(min(len(pool["queue"]), REROUTE_CHUNK)):
+                    stranded.append(pool["queue"].popleft())
+                if pool["queue"]:
+                    pool["last_req"] = 0.0  # next _pump re-requests now
         for lease in granted:
             threading.Thread(target=self._lease_reader, args=(lease,),
                              daemon=True).start()
@@ -657,6 +712,11 @@ class DirectCaller:
         if granted:
             self._pump(klass)
             self._ensure_linger_thread()
+        elif stranded:
+            # Nothing granted and specs remain queued: re-pump so a fresh
+            # lease request goes out (no submit/result event will — the
+            # caller may already be parked in ray.get).
+            self._pump(klass)
 
     def _lease_reader(self, lease: _Lease):
         while not self._stopped:
@@ -666,55 +726,63 @@ class DirectCaller:
                 self._on_lease_dead(lease)
                 return
             if msg[0] == "dresult":
-                self._on_result(lease, msg[1], msg[2], msg[3], msg[4])
+                self._on_result_batch(lease, [msg[1:]])
+            elif msg[0] == "dresult_batch":
+                self._on_result_batch(lease, msg[1])
 
-    def _on_result(self, lease: _Lease, rid, ok, returns, meta):
+    def _on_result_batch(self, lease: _Lease, items):
+        """Apply a burst of results under ONE lock pass (one notify, one
+        outbound flush, one pump) — per-result locking was the caller-side
+        bottleneck at multi-client rates."""
         exported = []
+        dep_klasses = set()
         with self.lock:
-            entry = lease.inflight.pop(rid, None)
-            if entry is None:
-                return
+            for rid, _ok, returns, meta in items:
+                entry = lease.inflight.pop(rid, None)
+                if entry is None:
+                    continue
+                tid = TaskID(entry["tid_bin"])
+                nested = meta.get("nested") or [[] for _ in returns]
+                for i, descr in enumerate(returns):
+                    oid = tid.object_id(i)
+                    item_ok = descr[0] != protocol.ERROR
+                    bin_ = oid.binary()
+                    if bin_ in self._pending_exports:
+                        # The shell was exported to the head while pending
+                        # (delegated): complete it there too.
+                        self._pending_exports.discard(bin_)
+                        exported.append((bin_, item_ok, descr,
+                                         list(nested[i])
+                                         if i < len(nested) else [],
+                                         lease.worker_id))
+                    st = self.owned.get(oid)
+                    if st is None:
+                        continue
+                    if st.status != DELEGATED:
+                        st.status = READY if item_ok else ERRORED
+                    st.descr = descr
+                    if descr[0] == protocol.SHM:
+                        st.creator = lease
+                    if i < len(nested) and nested[i]:
+                        # The executor addref'd these at the head for us
+                        # (borrowed-ref transfer).  Bins WE own pin locally
+                        # instead — the head shell the executor's addref
+                        # created doesn't protect our local entry — and the
+                        # on-behalf head ref is returned immediately.
+                        for b in nested[i]:
+                            ist = self.owned.get(ObjectID(b))
+                            if ist is not None and ist.status != DELEGATED:
+                                ist.pins += 1
+                                st.nested_local.append(b)
+                                self._outbound.append(
+                                    ("head", ("decref", b)))
+                            else:
+                                st.nested_head.append(b)
+                    self._maybe_free_locked(oid, st)
+                self._unpin_entry_locked(entry)
+                dep_klasses.update(self._wake_deps_locked(entry))
             if not lease.inflight:
                 lease.idle_since = time.monotonic()
-            tid = TaskID(entry["tid_bin"])
-            nested = meta.get("nested") or [[] for _ in returns]
-            for i, descr in enumerate(returns):
-                oid = tid.object_id(i)
-                item_ok = descr[0] != protocol.ERROR
-                bin_ = oid.binary()
-                if bin_ in self._pending_exports:
-                    # The shell was exported to the head while pending
-                    # (delegated): complete it there too.
-                    self._pending_exports.discard(bin_)
-                    exported.append((bin_, item_ok, descr,
-                                     list(nested[i])
-                                     if i < len(nested) else [],
-                                     lease.worker_id))
-                st = self.owned.get(oid)
-                if st is None:
-                    continue
-                if st.status != DELEGATED:
-                    st.status = READY if item_ok else ERRORED
-                st.descr = descr
-                if descr[0] == protocol.SHM:
-                    st.creator = lease
-                if i < len(nested) and nested[i]:
-                    # The executor addref'd these at the head for us
-                    # (borrowed-ref transfer).  Bins WE own pin locally
-                    # instead — the head shell the executor's addref
-                    # created doesn't protect our local entry — and the
-                    # on-behalf head ref is returned immediately.
-                    for b in nested[i]:
-                        ist = self.owned.get(ObjectID(b))
-                        if ist is not None and ist.status != DELEGATED:
-                            ist.pins += 1
-                            st.nested_local.append(b)
-                            self._outbound.append(("head", ("decref", b)))
-                        else:
-                            st.nested_head.append(b)
-                self._maybe_free_locked(oid, st)
-            self._unpin_entry_locked(entry)
-            dep_klasses = self._wake_deps_locked(entry)
             self.cv.notify_all()
         if exported:
             try:
@@ -1091,7 +1159,7 @@ class DirectCaller:
                 if st is None or st.status == DELEGATED:
                     continue
                 if st.status == PENDING:
-                    # Export the shell now; _on_result follows up with
+                    # Export the shell now; _on_result_batch follows up with
                     # ("export_complete", ...).
                     batch.append((b, None, None, [], None))
                     st.status = DELEGATED
@@ -1145,7 +1213,8 @@ class DirectServer:
     def __init__(self, authkey: bytes, enqueue: Callable[[dict, Any], None],
                  register_func: Callable[[str, bytes], None],
                  shm_unlink: Callable[[str, int, bool], None],
-                 on_peer_msg: Optional[Callable] = None):
+                 on_peer_msg: Optional[Callable] = None,
+                 queue_empty: Optional[Callable[[], bool]] = None):
         from multiprocessing.connection import Listener
 
         host = os.environ.get("RAY_TPU_AGENT_LISTEN_HOST", "127.0.0.1")
@@ -1163,9 +1232,20 @@ class DirectServer:
         self._register_func = register_func
         self._shm_unlink = shm_unlink
         self._on_peer_msg = on_peer_msg
+        self._queue_empty = queue_empty or (lambda: True)
+        # Live reply channels: the worker's exec loop flushes buffered
+        # replies on queue drain; the periodic flusher bounds latency.
+        self._sources: set = set()
+        self._sources_lock = threading.Lock()
         self._stopped = False
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="ray_tpu-direct-accept").start()
+
+    def flush_replies(self):
+        with self._sources_lock:
+            sources = list(self._sources)
+        for src in sources:
+            src.flush()
 
     def _accept_loop(self):
         while not self._stopped:
@@ -1179,7 +1259,16 @@ class DirectServer:
                              daemon=True, name="ray_tpu-direct-rx").start()
 
     def _serve_conn(self, conn):
-        src = _DirectSource(conn)
+        src = _DirectSource(conn, self._queue_empty)
+        with self._sources_lock:
+            self._sources.add(src)
+        try:
+            self._serve_conn_inner(conn, src)
+        finally:
+            with self._sources_lock:
+                self._sources.discard(src)
+
+    def _serve_conn_inner(self, conn, src):
         while not self._stopped:
             try:
                 msg = protocol.recv(conn)
@@ -1193,7 +1282,13 @@ class DirectServer:
             if tag == "dexec":
                 task = msg[2]
                 task["_dreply"] = (src, msg[1])
+                src.note_enqueued(1)
                 self._enqueue(task, src)
+            elif tag == "dexec_batch":
+                src.note_enqueued(len(msg[1]))
+                for rid, task in msg[1]:
+                    task["_dreply"] = (src, rid)
+                    self._enqueue(task, src)
             elif tag == "dfunc":
                 self._register_func(msg[1], msg[2])
             elif tag == "dfree":
@@ -1223,18 +1318,48 @@ class DirectServer:
 
 
 class _DirectSource:
-    """Reply channel for one inbound direct connection."""
+    """Reply channel for one inbound direct connection.  Replies buffer
+    while more tasks are queued behind the current one and ride out as one
+    ``dresult_batch`` (mirrors the head-conn ``result_batch`` path) — the
+    worker's exec loop flushes on queue drain and the periodic flusher
+    bounds worst-case latency."""
 
-    __slots__ = ("conn", "send_lock")
+    __slots__ = ("conn", "send_lock", "pending", "_queue_empty", "_queued")
 
-    def __init__(self, conn):
+    _FLUSH_AT = 16
+
+    def __init__(self, conn, queue_empty=None):
         self.conn = conn
         self.send_lock = threading.Lock()
+        self.pending: List[tuple] = []
+        self._queue_empty = queue_empty or (lambda: True)
+        self._queued = 0  # THIS caller's tasks still unanswered
+
+    def note_enqueued(self, n: int):
+        with self.send_lock:
+            self._queued += n
 
     def reply(self, rid, ok, returns, meta):
+        with self.send_lock:
+            self.pending.append((rid, ok, returns, meta))
+            self._queued -= 1
+            n = len(self.pending)
+            drained = self._queued <= 0
+        # Flush on the CALLER's burst boundary, not the worker's global
+        # queue: another client's pipelined backlog must not hold a sync
+        # caller's lone reply hostage until the periodic flusher.
+        if n >= self._FLUSH_AT or drained or self._queue_empty():
+            self.flush()
+
+    def flush(self):
         try:
             with self.send_lock:
-                protocol.send(self.conn,
-                              ("dresult", rid, ok, returns, meta))
+                if not self.pending:
+                    return
+                buf, self.pending = self.pending, []
+                if len(buf) == 1:
+                    protocol.send(self.conn, ("dresult",) + buf[0])
+                else:
+                    protocol.send(self.conn, ("dresult_batch", buf))
         except Exception:
             pass  # caller went away; its death handling cleans up

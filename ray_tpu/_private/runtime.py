@@ -467,6 +467,17 @@ class Runtime:
         # per batch instead of one per task.
         self._sender_event = threading.Event()
         self._dirty_workers: set = set()
+        # Client lease requests waiting for capacity (reference: the
+        # raylet's queued RequestWorkerLease); serviced by _dispatch_locked
+        # on every resource release, expired by a per-request timer.
+        self._pending_client_leases: deque = deque()
+        # Actor-handle transfer tokens (actor.py __reduce__): token ->
+        # actor_id for unconsumed pickled-handle counts; the consumed set
+        # absorbs cross-connection create/consume reordering (bounded —
+        # eviction of a real early consume merely leaves the actor's
+        # count conservatively high).
+        self._actor_tokens: Dict[bytes, bytes] = {}
+        self._actor_tokens_consumed: set = set()
         self._sender = threading.Thread(
             target=self._task_sender_loop, daemon=True,
             name="ray_tpu-sender")
@@ -557,14 +568,62 @@ class Runtime:
         return False
 
     def add_local_reference(self, object_id: ObjectID):
+        coll = getattr(self._tls, "reg_collector", None)
+        if coll is not None:
+            # Deserialization in progress: refs created by unpickling are
+            # registered as ONE batch under one lock when the load
+            # finishes (a 10k-ref container would otherwise take the
+            # runtime lock 10k times; reference: reference_count.cc
+            # batches borrower registration per message).
+            coll.append((object_id, 1))
+            return
         with self.lock:
             st = self.objects.get(object_id)
             if st is None:
                 st = self.objects[object_id] = ObjectState()
             st.local_refs += 1
 
+    def _begin_bulk_refs(self):
+        prev = getattr(self._tls, "reg_collector", None)
+        self._tls.reg_collector = []
+        return prev
+
+    def _end_bulk_refs(self, prev):
+        coll = getattr(self._tls, "reg_collector", None)
+        self._tls.reg_collector = prev
+        if not coll:
+            return
+        if prev is not None:
+            prev.extend(coll)  # nested load: the outermost applies
+            return
+        with self.lock:
+            # Increments first: a (+1, -1) pair for the same oid must
+            # never transit zero regardless of arrival order.
+            for oid, delta in coll:
+                if delta <= 0:
+                    continue
+                st = self.objects.get(oid)
+                if st is None:
+                    st = self.objects[oid] = ObjectState()
+                st.local_refs += 1
+            for oid, delta in coll:
+                if delta > 0:
+                    continue
+                st = self.objects.get(oid)
+                if st is not None:
+                    st.local_refs -= 1
+                    self._maybe_free_locked(oid, st)
+
     def remove_local_reference(self, object_id: ObjectID):
         if self._stopped:
+            return
+        coll = getattr(self._tls, "reg_collector", None)
+        if coll is not None:
+            # Mid-deserialization drop (a load-time __del__): defer it
+            # with the batched increments — applying it immediately while
+            # the matching +1 sits in the collector could free an object
+            # that is still referenced.
+            coll.append((object_id, -1))
             return
         with self.lock:
             st = self.objects.get(object_id)
@@ -838,6 +897,19 @@ class Runtime:
                 # a concurrent free must not pool and reuse the segment's
                 # inode while we are mapping/deserializing it.
                 st.shipped = True
+        prev = self._begin_bulk_refs()
+        try:
+            value = self._materialize_value(oid, descr, _recovering)
+        finally:
+            self._end_bulk_refs(prev)
+        with self.lock:
+            st2 = self.objects.get(oid)
+            if st2 is not None:
+                st2.value = value
+                st2.has_value = True
+        return value
+
+    def _materialize_value(self, oid: ObjectID, descr, _recovering):
         kind = descr[0]
         if kind == protocol.INLINE:
             value = serialization.loads_inline(descr[1])
@@ -899,11 +971,6 @@ class Runtime:
                         st2.segment = seg
         else:  # error
             raise serialization.loads_inline(descr[1])
-        with self.lock:
-            st2 = self.objects.get(oid)
-            if st2 is not None:
-                st2.value = value
-                st2.has_value = True
         return value
 
     def _register_lineage_locked(self, spec: dict):
@@ -1247,6 +1314,46 @@ class Runtime:
                 return node
         return None
 
+    def _lend_node_locked(self, rec: "TaskRecord") -> Optional[NodeState]:
+        """Over-capacity admission backed by BLOCKED workers — without
+        this, a cluster fully packed with actors deadlocks the moment an
+        actor blocks on tasks that need a slot (reference: extra workers
+        for blocked ones, worker_pool.cc backpressured by
+        ray_config_def.h:174-187).
+
+        Bound: a blocked worker's RELEASED slot already re-entered
+        ``available`` (the "blocked" handler), and this path additionally
+        admits up to one lent slot per blocked worker (so ≤2x per blocked
+        worker, capped by ``max_extra_blocked_workers`` per node).  The
+        looser 2x bound is deliberate: the released slot may legally be
+        consumed by a permanent holder (a new actor), and the tasks the
+        blocker waits on must STILL be admissible or the deadlock
+        returns.  CPU oversubscription is transient and OS-scheduled.
+        Transient CPU leases only: permanent holders (actors, TPU
+        workers, PG bundles) never ride a lent slot."""
+        if rec.is_actor_creation or rec.pg_id is not None:
+            return None
+        if rec.spec.get("scheduling_strategy"):
+            return None
+        req = rec.requirements
+        if any(k not in ("CPU", "memory") for k in req):
+            return None
+        for nid in self.node_order:
+            node = self.nodes[nid]
+            if not node.alive:
+                continue
+            blocked = sum(1 for w in node.all_workers.values()
+                          if w.blocked and not w.dead)
+            if blocked <= 0:
+                continue
+            lend = min(blocked, self.config.max_extra_blocked_workers)
+            if (node.available.get("CPU", 0.0) - req.get("CPU", 0.0)
+                    >= -lend - 1e-9
+                    and all(node.available.get(k, 0.0) >= v - 1e-9
+                            for k, v in req.items() if k != "CPU")):
+                return node
+        return None
+
     def _sched_class(self, rec: "TaskRecord") -> tuple:
         strategy = rec.spec.get("scheduling_strategy")
         # pg targeting is already covered by (pg_id, bundle_index); for the
@@ -1295,11 +1402,14 @@ class Runtime:
                     # capacity is preferred so a long task can't head-of-
                     # line-block a short one while CPUs sit idle.
                     worker = self._find_pipelinable_worker_locked(key)
-                    if worker is None:
+                    if worker is not None:
+                        q.popleft()
+                        self._assign_to_worker_locked(worker, rec)
+                        continue
+                    # Last resort: blocked workers lend their slots.
+                    node = self._lend_node_locked(rec)
+                    if node is None:
                         break   # same class behind it cannot place either
-                    q.popleft()
-                    self._assign_to_worker_locked(worker, rec)
-                    continue
                 use_pg = rec.pg_id is not None
                 if use_pg:
                     pg = self.placement_groups.get(rec.pg_id)
@@ -1335,6 +1445,7 @@ class Runtime:
                 self._assign_to_worker_locked(worker, rec)
             if not q:
                 self.pending_tasks.pop(key, None)
+        self._service_client_leases_locked()
 
     def _find_pipelinable_worker_locked(
             self, key: tuple) -> Optional[WorkerHandle]:
@@ -1642,33 +1753,97 @@ class Runtime:
         with self.lock:
             self._dispatch_locked()
 
+    # How long an unfulfillable client lease request is parked at the head
+    # before an empty grant is returned (the caller then falls back to the
+    # head path for a bounded chunk and re-requests).  The reference's
+    # raylet queues RequestWorkerLease indefinitely; we bound it so a
+    # zero-capacity cluster still makes progress via the head scheduler.
+    CLIENT_LEASE_PARK_S = 1.0
+
     def _grant_client_leases(self, lessee: WorkerHandle, rid,
                              resources: Dict[str, float], n: int):
         """Lease up to ``n`` workers to a peer caller for direct task
         push.  The head acquires node resources (exactly like a dispatch
         lease) but never sees the tasks; the caller returns the lease via
         ("lease_return", ...) or by dying (reference: raylet
-        RequestWorkerLease / ReturnWorker)."""
-        req = {k: float(v) for k, v in resources.items()}
-        granted: List[WorkerHandle] = []
-        with self.lock:
-            for _ in range(max(1, n)):
-                pseudo = TaskRecord(
-                    {"resources": req, "num_returns": 0,
-                     "name": "client_lease", "task_id": b""}, req, 0)
-                node = self._pick_node_locked(pseudo)
-                if node is None:
-                    break
-                node.acquire(req)
-                pseudo.node = node
-                w = self._lease_worker_locked(node, pseudo, [])
-                w.lease_req = dict(req)
-                w.client_lease = lessee
-                granted.append(w)
-        if not granted:
-            worker_send_safe(lessee, ("reply", rid, []))
-            return
+        RequestWorkerLease / ReturnWorker).
 
+        Zero-grant requests are PARKED, not refused: the request waits
+        (bounded) for resources to free, exactly like the raylet's lease
+        queue — an immediate empty reply made every concurrent caller dump
+        its whole queue on the head the moment leases momentarily ran out,
+        which is what collapsed multi-client task throughput."""
+        req = {k: float(v) for k, v in resources.items()}
+        with self.lock:
+            granted = self._try_client_grant_locked(lessee, req, n)
+            if not granted:
+                park = {"lessee": lessee, "rid": rid, "req": req, "n": n,
+                        "deadline": time.monotonic()
+                        + self.CLIENT_LEASE_PARK_S}
+                self._pending_client_leases.append(park)
+                t = threading.Timer(self.CLIENT_LEASE_PARK_S + 0.02,
+                                    self._sweep_client_leases)
+                t.daemon = True
+                t.start()
+                return
+        self._finish_client_grant(lessee, rid, granted)
+
+    def _try_client_grant_locked(self, lessee: WorkerHandle,
+                                 req: Dict[str, float],
+                                 n: int) -> List[WorkerHandle]:
+        granted: List[WorkerHandle] = []
+        for _ in range(max(1, n)):
+            pseudo = TaskRecord(
+                {"resources": req, "num_returns": 0,
+                 "name": "client_lease", "task_id": b""}, req, 0)
+            node = self._pick_node_locked(pseudo)
+            if node is None:
+                # Client leases are transient: blocked workers (usually
+                # the requesting clients themselves, parked in ray.get)
+                # lend their slots here too.
+                node = self._lend_node_locked(pseudo)
+            if node is None:
+                break
+            node.acquire(req)
+            pseudo.node = node
+            w = self._lease_worker_locked(node, pseudo, [])
+            w.lease_req = dict(req)
+            w.client_lease = lessee
+            granted.append(w)
+        return granted
+
+    def _service_client_leases_locked(self):
+        """Try parked client lease requests against freed capacity; called
+        from _dispatch_locked (which runs on every resource release).
+        Successful grants finish on a thread (they wait for worker spawn);
+        expired requests get their empty reply so the caller can fall
+        back."""
+        if not self._pending_client_leases:
+            return
+        now = time.monotonic()
+        still: deque = deque()
+        while self._pending_client_leases:
+            p = self._pending_client_leases.popleft()
+            if p["lessee"].dead:
+                continue
+            granted = self._try_client_grant_locked(
+                p["lessee"], p["req"], p["n"])
+            if granted:
+                self._finish_client_grant(p["lessee"], p["rid"], granted)
+            elif now >= p["deadline"]:
+                p["lessee"].queue_msg(("reply", p["rid"], []))
+                self._dirty_workers.add(p["lessee"])
+                self._sender_event.set()
+            else:
+                still.append(p)
+        self._pending_client_leases = still
+
+    def _sweep_client_leases(self):
+        with self.lock:
+            self._service_client_leases_locked()
+
+    def _finish_client_grant(self, lessee: WorkerHandle, rid,
+                             granted: List[WorkerHandle]):
         def finish():
             # One shared deadline across the batch (not 15s each): a
             # stuck spawn must not serialize into minutes of stall.
@@ -2007,6 +2182,114 @@ class Runtime:
         for rec in list(actor.inflight.values()):
             self._fail_task_locked(rec, error)
         actor.inflight.clear()
+
+    # ------------------------------------------- actor handle refcounts --
+    # Reference: actor out-of-scope GC (gcs_actor_manager.h + the core
+    # worker's actor handle reference counting).  Every live handle holds
+    # one count; pickling adds an in-flight count the deserialized copy
+    # owns.  Zero count on an unnamed, non-detached actor schedules a
+    # deferred termination check — deferred (not immediate) because an
+    # in-flight +1 from another process's pickle may still be on the wire.
+    _ACTOR_GC_DEFER_S = 1.0
+
+    def actor_handle_addref(self, actor_id: bytes):
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is not None:
+                actor.handle_count += 1
+
+    def actor_handle_serialized(self, actor_id: bytes, token: bytes):
+        """A pickled handle holds one count bound to ``token`` until the
+        first deserialization returns it (actor.py __reduce__)."""
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return
+            if token in self._actor_tokens_consumed:
+                # The consume beat the create across connections: cancel
+                # out without ever incrementing.
+                self._actor_tokens_consumed.discard(token)
+                return
+            self._actor_tokens[token] = actor_id
+            actor.handle_count += 1
+
+    _TOKEN_CONSUMED_CAP = 1 << 16
+
+    def actor_handle_deserialized(self, actor_id: bytes, token: bytes):
+        with self.lock:
+            aid = self._actor_tokens.pop(token, None)
+            if aid is None:
+                # create not seen yet (cross-conn race) — or a second+
+                # materialization of the same pickle, which holds no
+                # transfer count.  Only the former must be remembered.
+                if len(self._actor_tokens_consumed) < \
+                        self._TOKEN_CONSUMED_CAP:
+                    self._actor_tokens_consumed.add(token)
+                return
+        self.actor_handle_decref(aid)
+
+    def actor_handle_decref(self, actor_id: bytes):
+        if self._stopped:
+            return
+        schedule = False
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return
+            actor.handle_count -= 1
+            if (actor.handle_count <= 0 and actor.name is None
+                    and actor.options.get("lifetime") != "detached"
+                    and actor.status != DEAD):
+                schedule = True
+        if schedule:
+            t = threading.Timer(self._ACTOR_GC_DEFER_S,
+                                self._maybe_gc_actor, args=(actor_id,))
+            t.daemon = True
+            t.start()
+
+    def _maybe_gc_actor(self, actor_id: bytes):
+        """Terminate an actor whose handle count stayed at zero; waits for
+        queued/inflight method calls to drain first (their result refs are
+        still live even though the handle is gone — the reference also
+        runs outstanding work before the out-of-scope kill)."""
+        if self._stopped:
+            return
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None or actor.status == DEAD:
+                return
+            if actor.handle_count > 0 or actor.name is not None:
+                return
+            if actor.inflight or actor.queue:
+                busy = True
+            elif actor.status == "PENDING":
+                # Not yet created and nobody can reference it anymore.
+                # Queued creation: fail the record now (releases its
+                # pinned init-arg refs).  Dispatched creation: mark it
+                # cancelled — the creation result handler reaps the
+                # worker on arrival.
+                busy = False
+                for rec in list(self.tasks.values()):
+                    if rec.is_actor_creation and rec.actor_id == actor_id:
+                        rec.cancelled = True
+                        if not rec.dispatched:
+                            self._fail_task_locked(
+                                rec, exc.ActorDiedError(
+                                    "Actor went out of scope before "
+                                    "creation"), dispatchable=False)
+                actor.status = DEAD
+                actor.death_cause = "out of scope"
+                self._gcs_dirty += 1
+                return
+            else:
+                busy = False
+        if busy:
+            t = threading.Timer(self._ACTOR_GC_DEFER_S,
+                                self._maybe_gc_actor, args=(actor_id,))
+            t.daemon = True
+            t.start()
+            return
+        self.kill_actor(actor_id, no_restart=True)
 
     def kill_actor(self, actor_id: bytes, no_restart=True):
         with self.lock:
@@ -2455,6 +2738,15 @@ class Runtime:
                     if st is not None:
                         st.worker_refs -= 1
                         self._maybe_free_locked(oid, st)
+        elif tag == "actor_addref":
+            self.actor_handle_addref(msg[1])
+        elif tag == "actor_decref_batch":
+            for aid in msg[1]:
+                self.actor_handle_decref(aid)
+        elif tag == "actor_token_new":
+            self.actor_handle_serialized(msg[1], msg[2])
+        elif tag == "actor_token_used":
+            self.actor_handle_deserialized(msg[1], msg[2])
         elif tag == "addref_batch":
             with self.lock:
                 for b in msg[1]:
@@ -2767,6 +3059,14 @@ class Runtime:
             if rec.is_actor_creation:
                 actor = self.actors[rec.actor_id]
                 worker.inflight.pop(task_id_bin, None)
+                if actor.status == DEAD or rec.cancelled:
+                    # GC'd (all handles dropped) or cancelled while the
+                    # creation was in flight: the worker must not become
+                    # a live actor nobody can ever reference — retire it
+                    # and return its slot.
+                    self._end_lease_locked(worker, reap=True)
+                    self._dispatch_locked()
+                    return
                 if ok:
                     actor.status = ALIVE
                     actor.worker = worker
@@ -2920,6 +3220,11 @@ class Runtime:
             actor.death_cause = err
             self._gcs_dirty += 1
             self._fail_actor_queue_locked(actor, err)
+            # The lease just returned the actor's resources: anything
+            # waiting on capacity (pending tasks, parked client leases)
+            # must get a dispatch pass — without this, a task submitted
+            # while the actor held the last slot pends forever.
+            self._dispatch_locked()
 
     # ------------------------------------------------------------- reaper --
     def _reap_loop(self):

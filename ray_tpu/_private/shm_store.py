@@ -7,8 +7,9 @@ VM the picture is simpler: host RAM is big, objects are mostly numpy/jax
 host arrays moving between one driver and a handful of worker processes on
 the same host.  So v1 uses one POSIX shm file per object under ``/dev/shm``
 — creation is O(1), cross-process attach is just open+mmap, and the kernel
-does refcounting of the mapping for us.  (A C++ arena allocator with the
-same API slots in behind this module later; see src/ in this repo.)
+does refcounting of the mapping for us.  The plasma-arena analog is the
+segment pool below: freed-but-still-mapped segments are recycled so writes
+go through already-faulted pages at memcpy speed.
 
 Each segment:  [8B magic][8B meta_len][meta pickle][aligned buffers...]
 
@@ -35,8 +36,8 @@ _HEADER = struct.Struct("<8sQ")  # magic, meta_len
 # Large-buffer writes fan out across threads: numpy's copy releases the
 # GIL, so a single put saturates memory bandwidth instead of one core's
 # memcpy (the plasma store's parallel memcopy, store.cc memcopy_threads).
-_PARALLEL_COPY_MIN = 64 << 20
-_COPY_THREADS = 4
+_PARALLEL_COPY_MIN = 16 << 20
+_COPY_THREADS = min(8, max(1, (os.cpu_count() or 1)))
 _copy_pool = None
 _copy_pool_lock = threading.Lock()
 

@@ -64,6 +64,8 @@ class _WorkerRuntime:
         # holding this lock can re-enter __del__ on the same thread.
         self._decref_buf: list = []
         self._decref_lock = threading.RLock()
+        # Actor-handle drops, buffered for the same __del__ reasons.
+        self._actor_decref_buf: list = []
         # Per-thread task context: concurrent actor threads must not
         # cross-contaminate (reference: per-thread context in worker.py).
         self._tls = threading.local()
@@ -194,9 +196,12 @@ class _WorkerRuntime:
 
     def _send(self, msg):
         head_bins = self._drain_decrefs()
+        abuf = self._drain_actor_decrefs()
         with self.send_lock:
             if head_bins:
                 protocol.send(self.conn, ("decref_batch", head_bins))
+            if abuf:
+                protocol.send(self.conn, ("actor_decref_batch", abuf))
             protocol.send(self.conn, msg)
 
     def send_result(self, entry):
@@ -221,10 +226,52 @@ class _WorkerRuntime:
 
     def flush_decrefs(self):
         head_bins = self._drain_decrefs()
-        if not head_bins:
+        abuf = self._drain_actor_decrefs()
+        if not head_bins and not abuf:
             return
         with self.send_lock:
-            protocol.send(self.conn, ("decref_batch", head_bins))
+            if head_bins:
+                protocol.send(self.conn, ("decref_batch", head_bins))
+            if abuf:
+                protocol.send(self.conn, ("actor_decref_batch", abuf))
+
+    # Actor-handle refcounts (reference: actor out-of-scope GC) — the head
+    # keeps the authoritative count; addref is sent inline (pickle-time,
+    # safe context), decref buffers (fires from __del__).
+    def actor_handle_addref(self, actor_id: bytes):
+        self._send(("actor_addref", actor_id))
+
+    def actor_handle_serialized(self, actor_id: bytes, token: bytes):
+        self._send(("actor_token_new", actor_id, token))
+
+    def actor_handle_deserialized(self, actor_id: bytes, token: bytes):
+        self._send(("actor_token_used", actor_id, token))
+
+    def actor_handle_decref(self, actor_id: bytes):
+        try:
+            with self._decref_lock:
+                self._actor_decref_buf.append(actor_id)
+        except Exception:
+            pass  # shutting down
+
+    def _drain_actor_decrefs(self) -> list:
+        """Pop buffered actor-handle drops, HOLDING any whose direct
+        channel still has queued/inflight calls — the head cannot see
+        direct pushes, so a decref racing ahead of this worker's own
+        in-flight calls could zero the count and GC-kill the actor
+        mid-call."""
+        with self._decref_lock:
+            abuf, self._actor_decref_buf = self._actor_decref_buf, []
+        if not abuf:
+            return abuf
+        out, keep = [], []
+        for aid in abuf:
+            (keep if self.direct.actor_channel_busy(aid)
+             else out).append(aid)
+        if keep:
+            with self._decref_lock:
+                self._actor_decref_buf.extend(keep)
+        return out
 
     def _request(self, msg_builder):
         req_id = next(self.req_counter)
@@ -245,6 +292,30 @@ class _WorkerRuntime:
 
     # -- descriptor handling ----------------------------------------------
     def materialize(self, descr) -> Any:
+        prev = getattr(self._tls, "reg_load", None)
+        self._tls.reg_load = []
+        try:
+            return self._materialize_inner(descr)
+        finally:
+            coll = getattr(self._tls, "reg_load", None)
+            self._tls.reg_load = prev
+            if coll:
+                if prev is not None:
+                    prev.extend(coll)  # nested load: outermost applies
+                else:
+                    adds = [oid for oid, d in coll if d > 0]
+                    drops = [oid for oid, d in coll if d <= 0]
+                    foreign = self.direct.addref_batch(adds)
+                    if foreign:
+                        # Rides the conn BEFORE any buffered drop of the
+                        # same oid (per-conn FIFO).
+                        self._send(("addref_batch", foreign))
+                    for oid in drops:
+                        if not self.direct.decref(oid):
+                            with self._decref_lock:
+                                self._decref_buf.append(oid.binary())
+
+    def _materialize_inner(self, descr) -> Any:
         kind = descr[0]
         if kind == protocol.INLINE:
             return serialization.loads_inline(descr[1])
@@ -328,11 +399,27 @@ class _WorkerRuntime:
 
     # -- runtime accessor API (mirrors driver Runtime) ---------------------
     def add_local_reference(self, object_id: ObjectID):
+        coll = getattr(self._tls, "reg_load", None)
+        if coll is not None:
+            # Deserialization in progress: batch-registered at load end —
+            # one ownership-lock pass for owned refs, ONE head message for
+            # foreign ones (a 10k-ref container otherwise sends 10k
+            # addrefs).
+            coll.append((object_id, 1))
+            return
         if self.direct.addref(object_id):
             return
         self._send(("addref", object_id.binary()))
 
     def remove_local_reference(self, object_id: ObjectID):
+        # Mid-deserialization drop on the loading thread: defer with the
+        # batched increments (a drop drained by a nested getparts send
+        # could otherwise reach the owner before its matching deferred
+        # +1 and transit zero).
+        coll = getattr(self._tls, "reg_load", None)
+        if coll is not None:
+            coll.append((object_id, -1))
+            return
         # Buffered, not sent: this runs from ObjectRef.__del__, which the GC
         # may invoke mid-pickle inside _send — taking send_lock here would
         # self-deadlock.  The batch is flushed before the next outgoing
@@ -747,6 +834,8 @@ def main():
     import time
     from multiprocessing.connection import Client
 
+    from multiprocessing import AuthenticationError
+
     address = protocol.parse_address(os.environ["RAY_TPU_ADDRESS"])
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     conn = None
@@ -754,9 +843,18 @@ def main():
         try:
             conn = Client(address, authkey=authkey)
             break
+        except AuthenticationError:
+            # Transient: the accept loop can drop a challenge mid-
+            # handshake under load (it serves one handshake at a time);
+            # the key itself is from this session's spawn env, so retry.
+            time.sleep(0.05 * (attempt + 1))
         except (ConnectionError, OSError):
             time.sleep(0.05 * (attempt + 1))
     if conn is None:
+        import sys as _s
+
+        print(f"[ray_tpu worker {os.getpid()}] could not reach driver at "
+              f"{address} after 20 attempts", file=_s.stderr)
         raise SystemExit(1)
     worker_entry(
         conn,
@@ -905,7 +1003,7 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
     direct_server = direct_mod.DirectServer(
         bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")),
         direct_enqueue, fns.put, rt.shm.unlink,
-        on_peer_msg=rt.dispatch_peer_msg)
+        on_peer_msg=rt.dispatch_peer_msg, queue_empty=_queue_empty)
     rt.direct_addr = direct_server.address
 
     def decref_flusher():
@@ -918,6 +1016,7 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                 # Bounds result-batch latency when a long task follows
                 # buffered short-task results.
                 rt.flush_results()
+                direct_server.flush_replies()
             except Exception:
                 return  # conn gone; reader exits the process
 
@@ -935,10 +1034,14 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
 
     while True:
         with tq_cv:
-            if not tasks:
-                # Queue drained: everything buffered goes out as one batch
-                # before this worker parks.
-                rt.flush_results()
+            drained = not tasks
+        if drained:
+            # Queue drained: everything buffered goes out as one batch
+            # before this worker parks.  Outside tq_cv: the flushes take
+            # send locks and must not hold up direct enqueues.
+            rt.flush_results()
+            direct_server.flush_replies()
+        with tq_cv:
             while not tasks:
                 tq_cv.wait()
             msg = tasks.popleft()

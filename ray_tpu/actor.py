@@ -64,11 +64,25 @@ class ActorMethod:
 
 
 class ActorHandle:
+    """Refcounted handle (reference: actor out-of-scope GC,
+    gcs_actor_manager.h "RemoveActorNameFromRegistry on all handles out of
+    scope").  Every live handle holds one count at the head; pickling a
+    handle adds one IN-FLIGHT count that the deserialized copy takes
+    ownership of (transfer-on-send).  When the count reaches zero the head
+    terminates the actor after its queued work drains — unnamed,
+    non-detached actors only (named actors here persist until killed or
+    job end, a deliberate simplification)."""
+
     def __init__(self, actor_id: bytes, method_meta: Dict[str, int],
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, *, _register: bool = True):
         self._actor_id = actor_id
         self._method_meta = method_meta
         self._name = name
+        if _register:
+            try:
+                require_runtime().actor_handle_addref(actor_id)
+            except Exception:
+                pass  # runtime not up (e.g. handle built during shutdown)
 
     @property
     def _id_hex(self):
@@ -100,15 +114,41 @@ class ActorHandle:
         return refs
 
     def __reduce__(self):
+        # Transfer-on-send with a one-shot token: the serialized bytes
+        # hold one count bound to ``token``; the FIRST deserialization
+        # returns it (each copy registers its own count in __init__), so
+        # a stored pickle materialized N times stays balanced.  A pickle
+        # that is never deserialized holds its count until job end — the
+        # documented slack vs the reference's full borrow protocol.
+        import os as _os
+
+        token = _os.urandom(8)
+        try:
+            require_runtime().actor_handle_serialized(self._actor_id,
+                                                      token)
+        except Exception:
+            pass
         return (_rebuild_handle, (self._actor_id, self._method_meta,
-                                  self._name))
+                                  self._name, token))
+
+    def __del__(self):
+        try:
+            require_runtime().actor_handle_decref(self._actor_id)
+        except Exception:
+            pass  # interpreter shutdown
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()[:12]})"
 
 
-def _rebuild_handle(actor_id, method_meta, name):
-    return ActorHandle(actor_id, method_meta, name)
+def _rebuild_handle(actor_id, method_meta, name, token=None):
+    h = ActorHandle(actor_id, method_meta, name)
+    if token is not None:
+        try:
+            require_runtime().actor_handle_deserialized(actor_id, token)
+        except Exception:
+            pass
+    return h
 
 
 def _collect_methods(cls) -> Dict[str, int]:
