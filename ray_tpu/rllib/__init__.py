@@ -21,10 +21,22 @@ from ray_tpu.rllib.env import VectorEnv
 from ray_tpu.rllib.replay_buffers import (
     PrioritizedReplayBuffer, ReplayActor, ReplayBuffer,
 )
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentBatch, MultiAgentEnv, MultiAgentRolloutWorker,
+    MultiAgentWorkerSet,
+)
+from ray_tpu.rllib.offline import (
+    BC, BCConfig, CQL, CQLConfig, ImportanceSampling, JsonReader,
+    JsonWriter, MARWIL, MARWILConfig, WeightedImportanceSampling,
+)
 
 __all__ = [
     "SampleBatch", "concat_batches", "ActorCriticMLP", "RolloutWorker",
     "WorkerSet", "Learner", "LearnerGroup", "Algorithm", "AlgorithmConfig",
     "PPO", "PPOConfig", "Impala", "ImpalaConfig", "DQN", "DQNConfig",
     "VectorEnv", "ReplayBuffer", "PrioritizedReplayBuffer", "ReplayActor",
+    "MultiAgentEnv", "MultiAgentBatch", "MultiAgentRolloutWorker",
+    "MultiAgentWorkerSet", "BC", "BCConfig", "MARWIL", "MARWILConfig",
+    "CQL", "CQLConfig", "JsonReader", "JsonWriter", "ImportanceSampling",
+    "WeightedImportanceSampling",
 ]

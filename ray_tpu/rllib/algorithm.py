@@ -32,6 +32,11 @@ class AlgorithmConfig:
         self.seed = 0
         self.learner_num_tpus = 0
         self.remote_learner = False
+        self.num_learners = 0
+        # Multi-agent (reference: config.multi_agent(...)): empty =
+        # single-agent.
+        self.policies: Dict[str, Any] = {}
+        self.policy_mapping_fn: Optional[Callable] = None
 
     # -- fluent sections (reference: .environment/.rollouts/.training) ----
     def environment(self, env_maker: Callable) -> "AlgorithmConfig":
@@ -57,13 +62,32 @@ class AlgorithmConfig:
             setattr(self, k, v)
         return self
 
+    def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
+                    policy_mapping_fn: Optional[Callable] = None
+                    ) -> "AlgorithmConfig":
+        """Reference: AlgorithmConfig.multi_agent (algorithm_config.py) —
+        ``policies`` maps policy_id -> model-config dict (or None to
+        infer from the env's spaces); ``policy_mapping_fn(agent_id) ->
+        policy_id`` routes agents."""
+        if policies is not None:
+            self.policies = policies
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
     def resources(self, *, learner_num_tpus: Optional[int] = None,
-                  remote_learner: Optional[bool] = None
+                  remote_learner: Optional[bool] = None,
+                  num_learners: Optional[int] = None
                   ) -> "AlgorithmConfig":
         if learner_num_tpus is not None:
             self.learner_num_tpus = learner_num_tpus
         if remote_learner is not None:
             self.remote_learner = remote_learner
+        if num_learners is not None:
+            # num_learners>1 data-parallelizes the update over an
+            # N-device mesh 'dp' axis (learner_group.py:51 scaling
+            # config; here scaling = sharding, not actor count).
+            self.num_learners = num_learners
         return self
 
     def to_dict(self) -> Dict[str, Any]:

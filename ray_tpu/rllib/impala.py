@@ -94,10 +94,24 @@ class Impala(Algorithm):
                                clip_rho=cfg.clip_rho_threshold,
                                clip_c=cfg.clip_c_threshold)
 
-        self.learner_group = LearnerGroup(lambda: Learner(
-            module, loss, optimizer=optax.chain(
-                optax.clip_by_global_norm(cfg.grad_clip),
-                optax.adam(cfg.lr)), seed=cfg.seed))
+        def make_learner(mesh=None):
+            # Time-major columns (T, B, ...) shard their ENV axis over
+            # dp; bootstrap rows (B, ...) shard axis 0.  The V-trace
+            # scan stays per-shard (it runs over T), XLA psums grads.
+            from jax.sharding import PartitionSpec
+
+            def spec(k, v):
+                return (PartitionSpec("dp") if k == "bootstrap_obs"
+                        else PartitionSpec(None, "dp"))
+
+            return Learner(
+                module, loss, optimizer=optax.chain(
+                    optax.clip_by_global_norm(cfg.grad_clip),
+                    optax.adam(cfg.lr)), seed=cfg.seed,
+                mesh=mesh, batch_spec=spec if mesh is not None else None)
+
+        self.learner_group = LearnerGroup(
+            make_learner, num_learners=cfg.num_learners)
         w = self.learner_group.get_weights()
         self.workers.sync_weights(w)
         # Kick off the async pipeline: one outstanding sample per worker.

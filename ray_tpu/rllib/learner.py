@@ -25,7 +25,14 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 
 
 class Learner:
-    """Holds params + optimizer; ``update`` jitted once."""
+    """Holds params + optimizer; ``update`` jitted once.
+
+    With ``mesh``, the update is DATA-PARALLEL over the mesh's ``dp``
+    axis: params/opt state live replicated, each batch row-shards over
+    dp, and the mean-loss gradient psum is inserted by XLA — this is the
+    whole MultiGPULearnerThread/NCCL apparatus of the reference
+    (rllib/execution/multi_gpu_learner_thread.py) expressed as sharding
+    annotations on one jitted program."""
 
     def __init__(self, module, loss_fn: Callable, *,
                  optimizer: Optional[optax.GradientTransformation] = None,
@@ -37,7 +44,15 @@ class Learner:
         self.params = module.init(jax.random.PRNGKey(seed))
         self._opt_state = self._optimizer.init(self.params)
         self._mesh = mesh
+        if mesh is not None and batch_spec is None:
+            from jax.sharding import PartitionSpec
+            batch_spec = PartitionSpec("dp")
         self._batch_spec = batch_spec
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            replicated = NamedSharding(mesh, PartitionSpec())
+            self.params = jax.device_put(self.params, replicated)
+            self._opt_state = jax.device_put(self._opt_state, replicated)
 
         def _update(params, opt_state, batch):
             (loss, metrics), grads = jax.value_and_grad(
@@ -51,14 +66,52 @@ class Learner:
 
         self._update = jax.jit(_update, donate_argnums=(0, 1))
 
+    def _shard_batch(self, dev_batch):
+        """device_put each column with its dp sharding.  ``batch_spec``
+        may be one PartitionSpec for every column or a callable
+        ``(key, value) -> PartitionSpec`` for mixed layouts (IMPALA's
+        time-major (T, B) columns + (B,) bootstrap rows).  The sharded
+        axis is trimmed to tile over dp."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        n = self._mesh.shape.get("dp", 1)
+
+        def dp_axis(spec, v):
+            return next((i for i, s in enumerate(spec)
+                         if s == "dp" and i < v.ndim), None)
+
+        # A batch whose dp axis cannot feed every device runs REPLICATED
+        # (correct, just not parallel) — trimming it to zero rows would
+        # silently NaN the update.
+        replicate = False
+        for k, v in dev_batch.items():
+            spec = (self._batch_spec(k, v) if callable(self._batch_spec)
+                    else self._batch_spec)
+            axis = dp_axis(spec, v)
+            if axis is not None and v.shape[axis] < n:
+                replicate = True
+                break
+        out = {}
+        for k, v in dev_batch.items():
+            spec = (self._batch_spec(k, v) if callable(self._batch_spec)
+                    else self._batch_spec)
+            if replicate:
+                spec = PartitionSpec()
+            elif n > 1:
+                axis = dp_axis(spec, v)
+                if axis is not None and v.shape[axis] % n:
+                    # Ragged tail cannot tile over dp: drop it (the SGD
+                    # minibatcher likewise discards partial minibatches).
+                    sl = [slice(None)] * v.ndim
+                    sl[axis] = slice(0, v.shape[axis]
+                                     - (v.shape[axis] % n))
+                    v = v[tuple(sl)]
+            out[k] = jax.device_put(v, NamedSharding(self._mesh, spec))
+        return out
+
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
-        if self._mesh is not None and self._batch_spec is not None:
-            from jax.sharding import NamedSharding
-            dev_batch = {
-                k: jax.device_put(v, NamedSharding(self._mesh,
-                                                   self._batch_spec))
-                for k, v in dev_batch.items()}
+        if self._mesh is not None:
+            dev_batch = self._shard_batch(dev_batch)
         self.params, self._opt_state, metrics = self._update(
             self.params, self._opt_state, dev_batch)
         return {k: float(v) for k, v in metrics.items()}
@@ -80,20 +133,66 @@ class Learner:
 
 
 class LearnerGroup:
-    """Reference: rllib/core/learner/learner_group.py:51.  v1 runs the
-    learner in-driver (the driver owns the TPU in single-host mode);
-    remote=True places it in a dedicated TPU actor."""
+    """Reference: rllib/core/learner/learner_group.py:51 (the scaling
+    config's num_learners).  The group's scaling is a MESH, not N actor
+    processes: ``num_learners=N`` builds an N-device ``Mesh(('dp',))``
+    and the one Learner's update shards over it — batch rows split
+    across devices, XLA psums the gradients (SURVEY §2.4's "JAX Learner
+    on TPU mesh").  ``remote=True`` additionally places it in a
+    dedicated TPU actor."""
+
+    @staticmethod
+    def make_dp_mesh(num_learners: int):
+        """An N-device ('dp',) mesh over the first N local devices."""
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if num_learners > len(devs):
+            raise ValueError(
+                f"num_learners={num_learners} > {len(devs)} devices")
+        return Mesh(_np.array(devs[:num_learners]), ("dp",))
 
     def __init__(self, learner_factory: Callable[[], Learner],
-                 remote: bool = False, num_tpus: int = 0):
+                 remote: bool = False, num_tpus: int = 0,
+                 num_learners: int = 0):
         self._remote = remote
+        self._num_learners = num_learners
+
+        def build() -> Learner:
+            """Factory + optional dp mesh: factories that accept a
+            ``mesh`` kwarg get the group's mesh injected (built inside
+            the owning process — a remote learner actor builds it over
+            ITS visible devices, i.e. its granted TPU chips)."""
+            if num_learners and num_learners > 1:
+                import inspect
+
+                mesh = LearnerGroup.make_dp_mesh(num_learners)
+                try:
+                    sig = inspect.signature(learner_factory)
+                    if "mesh" in sig.parameters:
+                        return learner_factory(mesh=mesh)
+                except (TypeError, ValueError):
+                    pass
+                lr = learner_factory()
+                # Factory unaware of meshes: re-home its state onto the
+                # group mesh (replicated) and shard batches over dp.
+                from jax.sharding import NamedSharding, PartitionSpec
+                replicated = NamedSharding(mesh, PartitionSpec())
+                lr._mesh = mesh
+                lr._batch_spec = PartitionSpec("dp")
+                lr.params = jax.device_put(lr.params, replicated)
+                lr._opt_state = jax.device_put(lr._opt_state, replicated)
+                return lr
+            return learner_factory()
+
         if remote:
             import ray_tpu as ray
 
             @ray.remote
             class _LearnerActor:
                 def __init__(self):
-                    self.learner = learner_factory()
+                    self.learner = build()
 
                 def update(self, batch):
                     return self.learner.update(batch)
@@ -111,7 +210,7 @@ class LearnerGroup:
                 num_tpus=num_tpus, num_cpus=1).remote()
             self._ray = ray
         else:
-            self._learner = learner_factory()
+            self._learner = build()
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         if self._remote:

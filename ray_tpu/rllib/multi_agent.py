@@ -1,0 +1,279 @@
+"""Multi-agent RL: env API, per-policy batches, policy-mapped rollouts.
+
+Reference surface: ``rllib/env/multi_agent_env.py`` (MultiAgentEnv — dict
+obs/action/reward keyed by agent id, ``__all__`` termination),
+``rllib/policy/sample_batch.py`` (MultiAgentBatch — {policy_id:
+SampleBatch} + env-step accounting), and the policy-mapping rollout in
+``rllib/evaluation/rollout_worker.py:166`` (policy_mapping_fn routes each
+agent's transition into its policy's batch).
+
+TPU division of labor is unchanged from the single-agent stack: rollout
+workers are CPU actors; each POLICY gets its own JAX Learner whose update
+is one jitted program.  Agents sharing a policy share parameters — their
+transitions concatenate into one batch, which is what makes parameter
+sharing the cheap default on a TPU (one big minibatch instead of N tiny
+per-agent updates).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu as ray
+from ray_tpu.rllib.models import ActorCriticMLP, sample_action
+from ray_tpu.rllib.rollout_worker import WorkerSet, compute_gae
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, LOGP, OBS, REWARDS, SampleBatch, VF_PREDS,
+    concat_batches,
+)
+
+ALL_DONE = "__all__"
+
+
+class MultiAgentEnv:
+    """Dict-keyed multi-agent environment (reference:
+    rllib/env/multi_agent_env.py).
+
+    ``reset() -> (obs_dict, info_dict)``;
+    ``step(action_dict) -> (obs, rewards, terminateds, truncateds, infos)``
+    — all dicts keyed by agent id.  ``terminateds[ALL_DONE]`` ends the
+    episode.  Only agents present in the obs dict act next step (supports
+    turn-based and agents joining/leaving mid-episode)."""
+
+    agent_ids: List[str] = []
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class MultiAgentBatch:
+    """{policy_id: SampleBatch} + env-step count (reference:
+    rllib/policy/sample_batch.py MultiAgentBatch — agent steps accumulate
+    per policy; env_steps counts environment transitions once)."""
+
+    def __init__(self, policy_batches: Dict[str, SampleBatch],
+                 env_steps: int):
+        self.policy_batches = policy_batches
+        self._env_steps = env_steps
+
+    def env_steps(self) -> int:
+        return self._env_steps
+
+    def agent_steps(self) -> int:
+        return sum(len(b) for b in self.policy_batches.values())
+
+    def __len__(self) -> int:
+        return self._env_steps
+
+    def __getitem__(self, policy_id: str) -> SampleBatch:
+        return self.policy_batches[policy_id]
+
+    def items(self):
+        return self.policy_batches.items()
+
+
+def concat_ma_batches(batches: List["MultiAgentBatch"]) -> "MultiAgentBatch":
+    pids = {p for b in batches for p in b.policy_batches}
+    merged = {}
+    for pid in pids:
+        parts = [b.policy_batches[pid] for b in batches
+                 if pid in b.policy_batches and len(b.policy_batches[pid])]
+        if parts:
+            merged[pid] = concat_batches(parts)
+    return MultiAgentBatch(merged, sum(b.env_steps() for b in batches))
+
+
+class _AgentBuffer:
+    """One agent's in-flight trajectory, flushed (GAE'd) on episode end."""
+
+    __slots__ = ("cols",)
+
+    def __init__(self):
+        self.cols = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGP,
+                                     VF_PREDS)}
+
+    def add(self, obs, act, rew, done, logp, vf):
+        c = self.cols
+        c[OBS].append(obs)
+        c[ACTIONS].append(act)
+        c[REWARDS].append(rew)
+        c[DONES].append(done)
+        c[LOGP].append(logp)
+        c[VF_PREDS].append(vf)
+
+    def __len__(self):
+        return len(self.cols[OBS])
+
+    def to_batch(self) -> SampleBatch:
+        c = self.cols
+        return SampleBatch({
+            OBS: np.asarray(c[OBS], np.float32),
+            ACTIONS: np.asarray(c[ACTIONS], np.int32),
+            REWARDS: np.asarray(c[REWARDS], np.float32),
+            DONES: np.asarray(c[DONES], bool),
+            LOGP: np.asarray(c[LOGP], np.float32),
+            VF_PREDS: np.asarray(c[VF_PREDS], np.float32),
+        })
+
+
+@ray.remote
+class MultiAgentRolloutWorker:
+    """CPU rollout actor with per-policy models and a policy-mapping fn
+    (reference: rollout_worker.py:166 — the policy map + per-agent
+    routing; sampler.py's _env_runner agent-to-policy bookkeeping)."""
+
+    def __init__(self, env_maker: Callable[[], MultiAgentEnv],
+                 policy_model_configs: Dict[str, Dict[str, Any]],
+                 policy_mapping_fn: Callable[[str], str],
+                 worker_index: int = 0, gamma: float = 0.99,
+                 lam: float = 0.95, seed: Optional[int] = None):
+        import jax
+
+        self._env = env_maker()
+        self._models = {pid: ActorCriticMLP(**mc)
+                        for pid, mc in policy_model_configs.items()}
+        self._apply = {pid: jax.jit(m.apply)
+                       for pid, m in self._models.items()}
+        self._params: Dict[str, Any] = {}
+        self._map = policy_mapping_fn
+        self._gamma, self._lam = gamma, lam
+        self._rng = np.random.default_rng(
+            seed if seed is not None else worker_index)
+        self._obs, _ = self._env.reset(
+            seed=int(self._rng.integers(2**31)))
+        self._bufs: Dict[str, _AgentBuffer] = {}
+        # Summed-over-agents return of the CURRENT episode; persists
+        # across sample() horizons so only true episode ends record a
+        # completed return (the single-agent worker's _ep_returns).
+        self._ep_reward_sum = 0.0
+        self._completed_returns: List[float] = []
+
+    def set_weights(self, weights: Dict[str, Any]):
+        self._params.update(weights)
+        return True
+
+    def _values_of(self, obs_dict) -> Dict[str, float]:
+        """Each live agent's value of its current obs under its policy
+        (truncation/horizon bootstrap)."""
+        out: Dict[str, float] = {}
+        for agent_id in self._bufs:
+            if agent_id in obs_dict:
+                pid = self._map(agent_id)
+                _, v = self._apply[pid](
+                    self._params[pid],
+                    np.asarray(obs_dict[agent_id], np.float32)[None, :])
+                out[agent_id] = float(np.asarray(v)[0])
+        return out
+
+    def _flush_trajectories(self,
+                            done_batches: Dict[str, List[SampleBatch]],
+                            last_values: Dict[str, float],
+                            terminated: bool):
+        """GAE each agent's trajectory into its policy's bucket.
+        ``last_values`` bootstraps truncated/horizon-cut trajectories.
+        Does NOT touch episode-return accounting — that belongs to true
+        episode ends only."""
+        for agent_id, buf in self._bufs.items():
+            if not len(buf):
+                continue
+            b = buf.to_batch()
+            last_v = 0.0 if terminated else last_values.get(agent_id, 0.0)
+            b = compute_gae(b, last_v, self._gamma, self._lam)
+            done_batches.setdefault(self._map(agent_id), []).append(b)
+        self._bufs = {}
+
+    def sample(self, num_env_steps: int) -> MultiAgentBatch:
+        assert self._params, "set_weights first"
+        done_batches: Dict[str, List[SampleBatch]] = {}
+        env_steps = 0
+        for _ in range(num_env_steps):
+            # Group the agents awaiting actions by policy: ONE forward
+            # pass per policy per step, not one per agent.
+            by_policy: Dict[str, List[str]] = {}
+            for agent_id in self._obs:
+                by_policy.setdefault(self._map(agent_id), []).append(
+                    agent_id)
+            actions, logps, vfs = {}, {}, {}
+            for pid, agent_ids in by_policy.items():
+                obs_arr = np.stack([self._obs[a] for a in agent_ids]) \
+                    .astype(np.float32)
+                logits, values = self._apply[pid](self._params[pid],
+                                                  obs_arr)
+                acts, lp = sample_action(np.asarray(logits), self._rng)
+                values = np.asarray(values)
+                for i, a in enumerate(agent_ids):
+                    actions[a] = int(acts[i])
+                    logps[a] = float(lp[i])
+                    vfs[a] = float(values[i])
+            nobs, rews, terms, truncs, _ = self._env.step(actions)
+            env_steps += 1
+            all_term = terms.get(ALL_DONE, False)
+            all_trunc = truncs.get(ALL_DONE, False)
+            for a, act in actions.items():
+                # GAE's done flag means TERMINATION (value of the next
+                # state is zero); a truncated agent's trajectory instead
+                # bootstraps from its final obs below.
+                agent_term = terms.get(a, False) or all_term
+                self._bufs.setdefault(a, _AgentBuffer()).add(
+                    self._obs[a], act, float(rews.get(a, 0.0)),
+                    bool(agent_term), logps[a], vfs[a])
+                self._ep_reward_sum += float(rews.get(a, 0.0))
+            if all_term or all_trunc:
+                if all_trunc and not all_term:
+                    # Time-limit truncation: bootstrap from the final
+                    # obs the env just returned.
+                    self._flush_trajectories(
+                        done_batches, self._values_of(nobs),
+                        terminated=False)
+                else:
+                    self._flush_trajectories(done_batches, {},
+                                             terminated=True)
+                self._completed_returns.append(self._ep_reward_sum)
+                self._ep_reward_sum = 0.0
+                nobs, _ = self._env.reset()
+            self._obs = nobs
+        # Sample horizon hit mid-episode: flush for training with a
+        # current-obs bootstrap, WITHOUT recording an episode return
+        # (the episode continues into the next sample() call).
+        if self._bufs:
+            self._flush_trajectories(done_batches,
+                                     self._values_of(self._obs),
+                                     terminated=False)
+        merged = {pid: concat_batches(parts)
+                  for pid, parts in done_batches.items() if parts}
+        return MultiAgentBatch(merged, env_steps)
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._completed_returns)
+        if clear:
+            self._completed_returns.clear()
+        return out
+
+
+class MultiAgentWorkerSet(WorkerSet):
+    """Fault-tolerant multi-agent rollout fleet: WorkerSet's recreate /
+    sample_sync / episode_returns machinery with the multi-agent worker
+    factory and batch merge swapped in."""
+
+    def __init__(self, env_maker, policy_model_configs, policy_mapping_fn,
+                 num_workers: int, gamma: float = 0.99, lam: float = 0.95,
+                 recreate_failed: bool = True):
+        self._make = lambda idx: MultiAgentRolloutWorker.options(
+            num_cpus=1).remote(
+                env_maker, policy_model_configs, policy_mapping_fn,
+                worker_index=idx, gamma=gamma, lam=lam, seed=idx)
+        self._workers = [self._make(i) for i in range(num_workers)]
+        self._recreate = recreate_failed
+
+    @staticmethod
+    def _concat(batches):
+        return concat_ma_batches(batches)
+
+    @staticmethod
+    def _empty():
+        return MultiAgentBatch({}, 0)

@@ -1,0 +1,443 @@
+"""Offline RL: logged-experience IO, behavior cloning, MARWIL, discrete
+CQL, and off-policy estimators.
+
+Reference surface: ``rllib/offline/json_reader.py`` / ``json_writer.py``
+(JSON-lines SampleBatch IO), ``rllib/offline/estimators/
+importance_sampling.py`` + ``weighted_importance_sampling.py`` (per-episode
+IS/WIS value estimates from behavior-logged action probs), and the
+algorithms ``rllib/algorithms/bc/``, ``rllib/algorithms/marwil/``,
+``rllib/algorithms/cql/``.
+
+TPU shape: readers yield numpy SampleBatches; every algorithm's update is
+the same single jitted Learner program as the online stack — offline just
+swaps the rollout fleet for a file/dataset reader (the reference does the
+same through its ``input_`` config).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+from ray_tpu.rllib.models import ActorCriticMLP
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, LOGP, NEXT_OBS, OBS, REWARDS, SampleBatch,
+    concat_batches,
+)
+
+_ARRAY_DTYPES = {OBS: np.float32, NEXT_OBS: np.float32,
+                 ACTIONS: np.int32, REWARDS: np.float32,
+                 LOGP: np.float32, DONES: bool}
+
+
+class JsonWriter:
+    """Append SampleBatches as JSON lines (reference: json_writer.py —
+    one serialized batch per line, files rolled by size; we roll only on
+    explicit ``new_file``)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def write(self, batch: SampleBatch):
+        rec = {k: np.asarray(v).tolist() for k, v in batch.items()}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class JsonReader:
+    """Cycle through logged batches (reference: json_reader.py:30 —
+    ``next()`` returns one SampleBatch, looping over the input files
+    forever; globs and directories accepted)."""
+
+    def __init__(self, inputs: str, shuffle: bool = True, seed: int = 0):
+        if os.path.isdir(inputs):
+            paths = sorted(_glob.glob(os.path.join(inputs, "*.json")))
+        else:
+            paths = sorted(_glob.glob(inputs)) or [inputs]
+        self._lines: List[str] = []
+        for p in paths:
+            with open(p, encoding="utf-8") as f:
+                self._lines.extend(
+                    ln for ln in f.read().splitlines() if ln.strip())
+        if not self._lines:
+            raise ValueError(f"No batches found in {inputs!r}")
+        self._rng = np.random.default_rng(seed)
+        self._shuffle = shuffle
+        self._order: List[int] = []
+
+    @staticmethod
+    def _decode(line: str) -> SampleBatch:
+        rec = json.loads(line)
+        return SampleBatch({
+            k: np.asarray(v, dtype=_ARRAY_DTYPES.get(k))
+            for k, v in rec.items()})
+
+    def next(self) -> SampleBatch:
+        if not self._order:
+            self._order = list(range(len(self._lines)))
+            if self._shuffle:
+                self._rng.shuffle(self._order)
+            else:
+                self._order.reverse()  # tail pops -> chronological order
+        return self._decode(self._lines[self._order.pop()])
+
+    def read_all(self) -> SampleBatch:
+        return concat_batches([self._decode(ln) for ln in self._lines])
+
+    def __iter__(self) -> Iterator[SampleBatch]:
+        while True:
+            yield self.next()
+
+
+# --------------------------------------------------------------------------
+# Off-policy estimators (reference: rllib/offline/estimators/*.py).
+# --------------------------------------------------------------------------
+
+def _episodes(batch: SampleBatch) -> List[SampleBatch]:
+    """Split on done flags (reference: estimators operate per episode)."""
+    dones = np.asarray(batch[DONES])
+    ends = np.nonzero(dones)[0]
+    out, start = [], 0
+    for e in ends:
+        out.append(batch.slice(start, int(e) + 1))
+        start = int(e) + 1
+    if start < len(dones):
+        out.append(batch.slice(start, len(dones)))
+    return out
+
+
+class ImportanceSampling:
+    """Ordinary importance sampling: V^pi ≈ mean_ep sum_t gamma^t
+    (prod_{t'<=t} pi/mu) r_t (reference: importance_sampling.py)."""
+
+    weighted = False
+
+    def __init__(self, policy_logp_fn, gamma: float = 0.99):
+        """``policy_logp_fn(obs, actions) -> logp`` under the TARGET
+        policy; the batch's ``action_logp`` column is the behavior
+        policy's logged prob."""
+        self._logp = policy_logp_fn
+        self._gamma = gamma
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        eps = _episodes(batch)
+        # Per-episode cumulative ratios, padded to the longest horizon so
+        # WIS can normalize across episodes at each t.
+        horizon = max(len(e) for e in eps)
+        cumr = np.zeros((len(eps), horizon), np.float64)
+        rews = np.zeros((len(eps), horizon), np.float64)
+        for i, ep in enumerate(eps):
+            target_logp = np.asarray(
+                self._logp(ep[OBS], ep[ACTIONS]), np.float64)
+            ratio = np.exp(target_logp - np.asarray(ep[LOGP], np.float64))
+            cumr[i, :len(ep)] = np.cumprod(ratio)
+            rews[i, :len(ep)] = ep[REWARDS]
+        disc = self._gamma ** np.arange(horizon)
+        if self.weighted:
+            norm = cumr.mean(axis=0)
+            norm = np.where(norm > 0, norm, 1.0)
+            v = (disc * cumr / norm * rews).sum(axis=1)
+        else:
+            v = (disc * cumr * rews).sum(axis=1)
+        behavior = (disc * rews).sum(axis=1)
+        return {
+            "v_behavior": float(behavior.mean()),
+            "v_target": float(v.mean()),
+            "v_gain": float(v.mean() / (abs(behavior.mean()) + 1e-8)),
+            "episodes": len(eps),
+        }
+
+
+class WeightedImportanceSampling(ImportanceSampling):
+    """WIS: cumulative ratios normalized by their cross-episode mean at
+    each step — biased but far lower variance (reference:
+    weighted_importance_sampling.py)."""
+
+    weighted = True
+
+
+# --------------------------------------------------------------------------
+# BC — behavior cloning (reference: rllib/algorithms/bc/bc.py: MARWIL
+# with beta=0, pure -logp supervised loss).
+# --------------------------------------------------------------------------
+
+class OfflineAlgorithmConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.input_path: Optional[str] = None
+        self.num_batches_per_step = 8
+
+    def offline_data(self, *, input_path: str,
+                     num_batches_per_step: Optional[int] = None
+                     ) -> "OfflineAlgorithmConfig":
+        self.input_path = input_path
+        if num_batches_per_step is not None:
+            self.num_batches_per_step = num_batches_per_step
+        return self
+
+
+def _infer_spaces_from_batch(batch: SampleBatch):
+    obs_dim = int(np.asarray(batch[OBS]).shape[-1])
+    num_actions = int(np.asarray(batch[ACTIONS]).max()) + 1
+    return obs_dim, num_actions
+
+
+def _probe_spaces(reader: JsonReader, scans: int = 5):
+    """(obs_dim, num_actions) inferred from logged batches; several
+    batches scanned so rare actions are not missed."""
+    obs_dim, num_actions = _infer_spaces_from_batch(reader.next())
+    for _ in range(scans - 1):
+        _, n2 = _infer_spaces_from_batch(reader.next())
+        num_actions = max(num_actions, n2)
+    return obs_dim, num_actions
+
+
+class _LearnerCheckpointMixin:
+    def save_checkpoint(self):
+        return self.learner.state()
+
+    def load_checkpoint(self, state):
+        self.learner.load_state(state)
+
+
+class BCConfig(OfflineAlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.bc_logstd_coeff = 0.0
+
+    @property
+    def algo_class(self):
+        return BC
+
+
+class BC(_LearnerCheckpointMixin, Algorithm):
+    config_class = BCConfig
+
+    def _setup(self, cfg: BCConfig):
+        self.reader = JsonReader(cfg.input_path, seed=cfg.seed)
+        obs_dim, num_actions = _probe_spaces(self.reader)
+        self.module = ActorCriticMLP(
+            obs_dim, num_actions,
+            hidden=tuple(cfg.model.get("hidden", (64, 64))))
+
+        def loss(params, module, batch):
+            logits, _ = module.apply(params, batch[OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch[ACTIONS][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            bc_loss = -jnp.mean(logp)
+            return bc_loss, {"bc_loss": bc_loss}
+
+        self.learner = Learner(self.module, loss,
+                               optimizer=optax.adam(cfg.lr), seed=cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        metrics: Dict[str, Any] = {}
+        n = 0
+        for _ in range(cfg.num_batches_per_step):
+            batch = self.reader.next()
+            metrics = self.learner.update(batch)
+            n += len(batch)
+        metrics["num_env_steps_trained"] = n
+        return metrics
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        logits, _ = self.module.apply(self.learner.params,
+                                      jnp.asarray(obs, jnp.float32))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+
+
+# --------------------------------------------------------------------------
+# MARWIL — monotonic advantage re-weighted imitation learning
+# (reference: rllib/algorithms/marwil/marwil.py — exp(beta*A) weighted BC
+# + value regression; BC is the beta=0 special case).
+# --------------------------------------------------------------------------
+
+class MARWILConfig(OfflineAlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+
+    @property
+    def algo_class(self):
+        return MARWIL
+
+
+class MARWIL(_LearnerCheckpointMixin, Algorithm):
+    config_class = MARWILConfig
+
+    def _setup(self, cfg: MARWILConfig):
+        self.reader = JsonReader(cfg.input_path, seed=cfg.seed)
+        obs_dim, num_actions = _probe_spaces(self.reader)
+        self.module = ActorCriticMLP(
+            obs_dim, num_actions,
+            hidden=tuple(cfg.model.get("hidden", (64, 64))))
+        gamma, beta, vf_coeff = cfg.gamma, cfg.beta, cfg.vf_coeff
+
+        def loss(params, module, batch):
+            logits, values = module.apply(params, batch[OBS])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch[ACTIONS][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            returns = batch["returns"]
+            adv = returns - values
+            # Advantage re-weighting with a stop-gradient through the
+            # weights (marwil_torch_policy.py does the same detach).
+            w = jnp.exp(jnp.clip(beta * jax.lax.stop_gradient(adv),
+                                 -10.0, 10.0))
+            pi_loss = -jnp.mean(w * logp)
+            vf_loss = jnp.mean(adv ** 2)
+            total = pi_loss + vf_coeff * vf_loss
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss}
+
+        self.learner = Learner(self.module, loss,
+                               optimizer=optax.adam(cfg.lr), seed=cfg.seed)
+        self._gamma = gamma
+
+    def _with_returns(self, batch: SampleBatch) -> SampleBatch:
+        """Discounted returns-to-go per episode (the advantage target)."""
+        rews = np.asarray(batch[REWARDS], np.float32)
+        dones = np.asarray(batch[DONES])
+        ret = np.zeros_like(rews)
+        acc = 0.0
+        for t in reversed(range(len(rews))):
+            acc = rews[t] + self._gamma * acc * (1.0 - float(dones[t]))
+            ret[t] = acc
+        out = SampleBatch(batch)
+        out["returns"] = ret
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        metrics: Dict[str, Any] = {}
+        n = 0
+        for _ in range(cfg.num_batches_per_step):
+            batch = self._with_returns(self.reader.next())
+            metrics = self.learner.update(batch)
+            n += len(batch)
+        metrics["num_env_steps_trained"] = n
+        return metrics
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        logits, _ = self.module.apply(self.learner.params,
+                                      jnp.asarray(obs, jnp.float32))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+
+
+# --------------------------------------------------------------------------
+# CQL — conservative Q-learning, discrete variant (reference:
+# rllib/algorithms/cql/cql.py; the conservative regularizer
+# logsumexp(Q) - Q(a_logged) keeps unseen actions' Q-values down so the
+# greedy policy stays inside the dataset's support).
+# --------------------------------------------------------------------------
+
+class CQLConfig(OfflineAlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.min_q_weight = 1.0
+        self.target_update_freq = 8
+        self.tau = 1.0
+
+    @property
+    def algo_class(self):
+        return CQL
+
+
+class CQL(Algorithm):
+    config_class = CQLConfig
+
+    def _setup(self, cfg: CQLConfig):
+        from ray_tpu.rllib.dqn import QNetworkMLP
+
+        self.reader = JsonReader(cfg.input_path, seed=cfg.seed)
+        obs_dim, num_actions = _probe_spaces(self.reader)
+        self.module = QNetworkMLP(
+            obs_dim, num_actions,
+            hidden=tuple(cfg.model.get("hidden", (64, 64))))
+        self.params = self.module.init(jax.random.PRNGKey(cfg.seed))
+        # jnp.copy, not identity: params are donated by the jitted update,
+        # so an aliasing target would reference donated (stale) buffers.
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._opt = optax.adam(cfg.lr)
+        self._opt_state = self._opt.init(self.params)
+        gamma, w_cons = cfg.gamma, cfg.min_q_weight
+        module = self.module
+
+        def update(params, target_params, opt_state, batch):
+            def loss_fn(p):
+                q = module.apply(p, batch[OBS])
+                q_a = jnp.take_along_axis(
+                    q, batch[ACTIONS][:, None].astype(jnp.int32),
+                    axis=-1)[:, 0]
+                # Double-Q target through the online argmax.
+                next_q_online = module.apply(p, batch[NEXT_OBS])
+                next_q_target = module.apply(target_params,
+                                             batch[NEXT_OBS])
+                next_a = jnp.argmax(next_q_online, axis=-1)
+                next_q = jnp.take_along_axis(
+                    next_q_target, next_a[:, None], axis=-1)[:, 0]
+                not_done = 1.0 - batch[DONES].astype(jnp.float32)
+                target = batch[REWARDS] + gamma * not_done * \
+                    jax.lax.stop_gradient(next_q)
+                td = jnp.mean((q_a - target) ** 2)
+                conservative = jnp.mean(
+                    jax.scipy.special.logsumexp(q, axis=-1) - q_a)
+                total = td + w_cons * conservative
+                return total, {"td_loss": td, "cql_loss": conservative}
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, dict(metrics, total_loss=loss)
+
+        self._update = jax.jit(update, donate_argnums=(0, 2))
+        self._steps = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        metrics: Dict[str, Any] = {}
+        n = 0
+        for _ in range(cfg.num_batches_per_step):
+            batch = self.reader.next()
+            dev = {k: jnp.asarray(v) for k, v in batch.items()}
+            self.params, self._opt_state, metrics = self._update(
+                self.params, self.target_params, self._opt_state, dev)
+            self._steps += 1
+            if self._steps % cfg.target_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+            n += len(batch)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["num_env_steps_trained"] = n
+        return out
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        q = self.module.apply(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(jnp.argmax(q, axis=-1))
+
+    def save_checkpoint(self):
+        return {"params": jax.device_get(self.params),
+                "target": jax.device_get(self.target_params)}
+
+    def load_checkpoint(self, state):
+        self.params = jax.device_put(state["params"])
+        self.target_params = jax.device_put(state["target"])

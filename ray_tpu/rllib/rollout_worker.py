@@ -128,7 +128,11 @@ class RolloutWorker:
 
 class WorkerSet:
     """Fault-tolerant rollout fleet (reference:
-    rllib/evaluation/worker_set.py — recreate failed workers)."""
+    rllib/evaluation/worker_set.py — recreate failed workers).
+
+    Subclasses swap the worker factory (``_make``) and batch merge
+    (``_concat``/``_empty``) — the multi-agent fleet reuses the whole
+    recreate/sample/returns machinery this way."""
 
     def __init__(self, env_maker, model_config, num_workers: int,
                  num_envs_per_worker: int = 1, gamma: float = 0.99,
@@ -138,6 +142,14 @@ class WorkerSet:
             num_envs=num_envs_per_worker, gamma=gamma, lam=lam, seed=idx)
         self._workers = [self._make(i) for i in range(num_workers)]
         self._recreate = recreate_failed
+
+    @staticmethod
+    def _concat(batches):
+        return concat_batches(batches)
+
+    @staticmethod
+    def _empty():
+        return SampleBatch()
 
     @property
     def workers(self):
@@ -155,7 +167,7 @@ class WorkerSet:
     def sync_weights(self, weights):
         ray.get([w.set_weights.remote(weights) for w in self._workers])
 
-    def sample_sync(self, steps_per_worker: int) -> SampleBatch:
+    def sample_sync(self, steps_per_worker: int):
         """synchronous_parallel_sample (reference:
         rllib/execution/rollout_ops.py:21) with worker recreation."""
         futs = {w.sample.remote(steps_per_worker): (i, w)
@@ -168,7 +180,7 @@ class WorkerSet:
                 if not self._recreate:
                     raise
                 self.recreate(i)
-        return concat_batches(out) if out else SampleBatch()
+        return self._concat(out) if out else self._empty()
 
     def episode_returns(self) -> List[float]:
         rets = []
