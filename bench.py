@@ -293,101 +293,133 @@ def tpu_bench():
         np.asarray(chain(q, k, v))
         return (time.perf_counter() - t0) / iters
 
-    # Flash attention fwd+bwd vs the XLA reference, bf16 shapes.
-    b, h, d = 4, 16, 64
-    for seq in (2048, 8192):
-        key = jax.random.PRNGKey(0)
-        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
-                                     (b, seq, h, d), dtype=jnp.bfloat16)
-                   for i in range(3))
-        t_flash = time_chained(flash_attention, q, k, v, 16)
-        # fwd 4*b*h*s^2*d + bwd 2x = 12 (full, non-causal count).
-        flops = 12 * b * h * seq * seq * d
-        out[f"flash_attn_s{seq}_ms"] = round(t_flash * 1e3, 3)
-        out[f"flash_attn_s{seq}_tflops"] = round(flops / t_flash / 1e12, 1)
-        extra = ""
-        if seq <= 2048:
-            # The XLA reference materializes (s, s) scores — OOMs at 8k;
-            # its existence at 2k is the speedup context.
-            t_ref = time_chained(mha_reference, q, k, v, 16)
-            out[f"flash_attn_s{seq}_vs_xla"] = round(t_ref / t_flash, 3)
-            extra = f", {t_ref/t_flash:.2f}x XLA ref"
-        try:
-            # jax's own pallas TPU flash kernel on the same shapes — the
-            # strongest public baseline for this op.
-            from jax.experimental.pallas.ops.tpu.flash_attention import (
-                flash_attention as jax_flash)
+    # Flash attention fwd+bwd vs the XLA reference, bf16 shapes.  d=64
+    # keys keep their round-3/4 names for cross-round comparison; d=128
+    # is the FLAGSHIP geometry (head_dim=128, __graft_entry__).
+    for (h, d) in ((16, 64), (8, 128)):
+        tag = "" if d == 64 else f"_d{d}"
+        b = 4
+        for seq in (2048, 8192):
+            key = jax.random.PRNGKey(0)
+            q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                         (b, seq, h, d),
+                                         dtype=jnp.bfloat16)
+                       for i in range(3))
+            t_flash = time_chained(flash_attention, q, k, v, 16)
+            # fwd 4*b*h*s^2*d + bwd 2x = 12 (full, non-causal count).
+            flops = 12 * b * h * seq * seq * d
+            out[f"flash_attn{tag}_s{seq}_ms"] = round(t_flash * 1e3, 3)
+            out[f"flash_attn{tag}_s{seq}_tflops"] = round(
+                flops / t_flash / 1e12, 1)
+            extra = ""
+            if seq <= 2048:
+                # The XLA reference materializes (s, s) scores — OOMs at
+                # 8k; its existence at 2k is the speedup context.
+                t_ref = time_chained(mha_reference, q, k, v, 16)
+                out[f"flash_attn{tag}_s{seq}_vs_xla"] = round(
+                    t_ref / t_flash, 3)
+                extra = f", {t_ref/t_flash:.2f}x XLA ref"
+            try:
+                # jax's own pallas TPU flash kernel on the same shapes —
+                # the strongest public baseline for this op.
+                from jax.experimental.pallas.ops.tpu.flash_attention \
+                    import flash_attention as jax_flash
 
-            def jx(qq, kk, vv, causal=True):
-                tq = jnp.transpose(qq, (0, 2, 1, 3))
-                tk = jnp.transpose(kk, (0, 2, 1, 3))
-                tv = jnp.transpose(vv, (0, 2, 1, 3))
-                o = jax_flash(tq, tk, tv, causal=causal,
-                              sm_scale=qq.shape[-1] ** -0.5)
-                return jnp.transpose(o, (0, 2, 1, 3))
+                def jx(qq, kk, vv, causal=True):
+                    tq = jnp.transpose(qq, (0, 2, 1, 3))
+                    tk = jnp.transpose(kk, (0, 2, 1, 3))
+                    tv = jnp.transpose(vv, (0, 2, 1, 3))
+                    o = jax_flash(tq, tk, tv, causal=causal,
+                                  sm_scale=qq.shape[-1] ** -0.5)
+                    return jnp.transpose(o, (0, 2, 1, 3))
 
-            t_jax = time_chained(jx, q, k, v, 16)
-            out[f"flash_attn_s{seq}_vs_jax_pallas"] = round(
-                t_jax / t_flash, 3)
-            extra += f", {t_jax/t_flash:.2f}x jax-pallas"
-        except Exception:
-            pass
-        print(f"  [tpu] flash s={seq}: {t_flash*1e3:.2f}ms "
-              f"({flops/t_flash/1e12:.1f} TF/s full-count{extra})",
-              file=sys.stderr)
+                t_jax = time_chained(jx, q, k, v, 16)
+                out[f"flash_attn{tag}_s{seq}_vs_jax_pallas"] = round(
+                    t_jax / t_flash, 3)
+                extra += f", {t_jax/t_flash:.2f}x jax-pallas"
+            except Exception:
+                pass
+            print(f"  [tpu] flash d={d} s={seq}: {t_flash*1e3:.2f}ms "
+                  f"({flops/t_flash/1e12:.1f} TF/s full-count{extra})",
+                  file=sys.stderr)
 
-    # Flagship train step: tokens/s + MFU.
+    # Train steps: flagship (162M, round-comparable keys) and a ~1.2B
+    # config where HBM is actually tight on one chip — remat + donation
+    # + bf16 params/optimizer are what make it fit (BASELINE.json
+    # north-star direction; reference scale context:
+    # release/alpa_tests/train_opt_2_7b_minimum.py).
     import optax
 
     from __graft_entry__ import _flagship_cfg
+    from ray_tpu.models import LlamaConfig
     from ray_tpu.train import init_train_state, make_train_step
 
-    cfg = _flagship_cfg()
-    batch, seq = 16, cfg.max_seq_len
-    opt = optax.adamw(1e-3)
-    state = init_train_state(jax.random.PRNGKey(0), cfg, opt)
-    step = make_train_step(cfg, opt, donate=False)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
-                                cfg.vocab_size, dtype=jnp.int32)
-    iters = 10
+    def train_bench(prefix, cfg, batch, iters):
+        seq = cfg.max_seq_len
+        opt = optax.adamw(1e-3)
+        state = init_train_state(jax.random.PRNGKey(0), cfg, opt)
+        step = make_train_step(cfg, opt, donate=False)
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, seq + 1), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
 
-    from functools import partial
+        from functools import partial
 
-    # State buffers are donated: XLA updates params/opt state in place
-    # across the whole scan instead of double-buffering ~3x param bytes.
-    @partial(jax.jit, donate_argnums=(0,))
-    def run(state, tokens):
-        def body(s, _):
-            s2, m = step(s, {"tokens": tokens})
-            return s2, m["loss"]
-        return jax.lax.scan(body, state, None, length=iters)
+        # State buffers are donated: XLA updates params/opt state in
+        # place across the whole scan instead of double-buffering ~3x
+        # param bytes — this is what lets the 1.2B config fit.
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(state, tokens):
+            def body(s, _):
+                s2, m = step(s, {"tokens": tokens})
+                return s2, m["loss"]
+            return jax.lax.scan(body, state, None, length=iters)
 
-    state, losses = run(state, tokens)   # compile + warm
-    np.asarray(losses)
-    t0 = time.perf_counter()
-    state, losses = run(state, tokens)
-    np.asarray(losses)
-    dt = (time.perf_counter() - t0) / iters
+        state, losses = run(state, tokens)   # compile + warm
+        np.asarray(losses)
+        t0 = time.perf_counter()
+        state, losses = run(state, tokens)
+        np.asarray(losses)
+        dt = (time.perf_counter() - t0) / iters
 
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
-    toks = batch * seq
-    # 6N per token (fwd+bwd matmuls) + attention 12*L*s*h*d per token.
-    step_flops = toks * (6 * n_params
-                         + 12 * cfg.num_layers * seq * cfg.num_heads
-                         * cfg.head_dim)
-    mfu = step_flops / dt / peak
-    out["train_step_ms"] = round(dt * 1e3, 2)
-    out["train_tokens_per_s"] = round(toks / dt)
-    out["train_mfu"] = round(mfu, 4)
-    # The step trains with full-layer remat (measured faster than both
-    # no-remat and selective policies on v5e — activations thrash HBM
-    # otherwise), so the device EXECUTES ~8N/6N of the counted FLOPs;
-    # this is the hardware-utilization number the counted MFU hides.
-    out["train_util_with_remat"] = round(mfu * 8.0 / 6.0, 4)
-    out["model_params_m"] = round(n_params / 1e6, 1)
-    print(f"  [tpu] train step: {dt*1e3:.1f}ms, {toks/dt:,.0f} tok/s, "
-          f"MFU {mfu*100:.1f}% ({n_params/1e6:.0f}M params, "
-          f"{dev.device_kind})", file=sys.stderr)
+        n_params = sum(x.size
+                       for x in jax.tree_util.tree_leaves(state.params))
+        toks = batch * seq
+        # 6N per token (fwd+bwd matmuls) + attention 12*L*s*h*d/token.
+        step_flops = toks * (6 * n_params
+                             + 12 * cfg.num_layers * seq * cfg.num_heads
+                             * cfg.head_dim)
+        mfu = step_flops / dt / peak
+        out[f"{prefix}_step_ms"] = round(dt * 1e3, 2)
+        out[f"{prefix}_tokens_per_s"] = round(toks / dt)
+        out[f"{prefix}_mfu"] = round(mfu, 4)
+        # Full-layer remat (measured faster than both no-remat and
+        # selective policies on v5e): the device EXECUTES ~8N/6N of the
+        # counted FLOPs; this is the hardware-utilization number the
+        # counted MFU hides.
+        out[f"{prefix}_util_with_remat"] = round(mfu * 8.0 / 6.0, 4)
+        out[f"{prefix}_params_m"] = round(n_params / 1e6, 1)
+        print(f"  [tpu] {prefix} step: {dt*1e3:.1f}ms, "
+              f"{toks/dt:,.0f} tok/s, MFU {mfu*100:.1f}% "
+              f"({n_params/1e6:.0f}M params, {dev.device_kind})",
+              file=sys.stderr)
+        del state, tokens
+
+    train_bench("train", _flagship_cfg(), batch=16, iters=10)
+    out["model_params_m"] = out.pop("train_params_m")  # legacy key
+    try:
+        # param_dtype=bf16: 1.2B params = 2.4GB + adam mu/nu 4.8GB —
+        # fp32 masters (14.4GB state) would not leave room for
+        # activations on a 16GB v5e chip.
+        cfg_1b = LlamaConfig(
+            vocab_size=32000, embed_dim=2048, num_layers=16,
+            num_heads=16, num_kv_heads=16, head_dim=128, mlp_dim=8192,
+            max_seq_len=2048, dtype=jnp.bfloat16,
+            param_dtype=jnp.bfloat16, attn_impl="flash", remat=True)
+        train_bench("train_1b", cfg_1b, batch=8, iters=4)
+    except Exception as e:  # noqa: BLE001 — 1B row must not kill bench
+        out["train_1b_error"] = repr(e)[:300]
+        print(f"  [tpu] train_1b failed: {e!r}", file=sys.stderr)
     return out
 
 

@@ -33,6 +33,7 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor, method
 from ray_tpu.remote_function import RemoteFunction, remote_decorator
 from ray_tpu.runtime_context import get_runtime_context
+from ray_tpu.util.tracing import timeline  # noqa: F401 (public API)
 from ray_tpu import exceptions
 
 __version__ = "0.1.0"
@@ -52,5 +53,6 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
     "available_resources", "ObjectRef", "ActorClass", "ActorHandle",
-    "RemoteFunction", "get_runtime_context", "exceptions", "__version__",
+    "RemoteFunction", "get_runtime_context", "timeline", "exceptions",
+    "__version__",
 ]

@@ -114,6 +114,18 @@ class Config:
     # head restarts so agents/clients can re-authenticate.
     authkey_hex: str = ""
 
+    # --- OOM memory monitor (reference: src/ray/common/memory_monitor.h
+    # + worker_killing_policy_group_by_owner.cc: kill the newest
+    # retriable task's worker before the kernel OOM-killer takes the
+    # node). ---
+    # Node memory usage fraction above which the monitor kills one task
+    # worker per interval.  0 disables.
+    memory_monitor_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
+    # Test hook: read the usage fraction from this file instead of
+    # /proc/meminfo (reference tests inject usage the same way).
+    memory_monitor_test_file: str = ""
+
     @classmethod
     def from_env(cls, overrides: dict | None = None) -> "Config":
         kwargs = {}

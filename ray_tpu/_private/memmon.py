@@ -1,0 +1,36 @@
+"""Node memory readings for the OOM monitor.
+
+Reference: ``src/ray/common/memory_monitor.h`` — the raylet samples
+/proc (cgroup-aware there) and triggers the worker-killing policy above a
+usage threshold.  We read /proc/meminfo's MemAvailable, which already
+accounts for reclaimable page cache the way the kernel's own OOM
+heuristics do.
+"""
+
+from __future__ import annotations
+
+
+def memory_usage_fraction(test_file: str = "") -> float:
+    """Fraction of node memory in use, 0.0-1.0.  ``test_file`` overrides
+    with a literal float (test injection; absent/invalid reads as 0)."""
+    if test_file:
+        try:
+            with open(test_file, encoding="utf-8") as f:
+                return float(f.read().strip())
+        except (OSError, ValueError):
+            return 0.0
+    total = avail = None
+    try:
+        with open("/proc/meminfo", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1])
+                if total is not None and avail is not None:
+                    break
+    except OSError:
+        return 0.0
+    if not total or avail is None:
+        return 0.0
+    return max(0.0, 1.0 - avail / total)

@@ -73,6 +73,46 @@ class NodeAgent:
         self.object_addr = protocol.format_address((adv, port))
         threading.Thread(target=self._object_server, daemon=True,
                          name="agent-objsrv").start()
+        threading.Thread(target=self._memory_monitor, daemon=True,
+                         name="agent-memmon").start()
+
+    def _memory_monitor(self):
+        """Sample this node's memory; over threshold, report pressure to
+        the head, which picks and kills a victim among OUR workers
+        (reference: memory_monitor.h sampling in the raylet; the policy
+        runs centrally here because the task table is head-resident).
+        Knobs come from the head's agent_ack (so ``_system_config``
+        applies cluster-wide), overridable per node via the standard
+        ``RAY_TPU_MEMORY_MONITOR_*`` env flags (config.py)."""
+        from ray_tpu._private import memmon
+        from ray_tpu._private.config import Config
+
+        env_cfg = Config.from_env()
+        while not self._stopped and not getattr(self, "head_config", None):
+            time.sleep(0.2)  # wait for the agent_ack
+        head_cfg = getattr(self, "head_config", {}) or {}
+
+        def knob(name):
+            env_val = getattr(env_cfg, name)
+            default = getattr(Config, name)
+            return env_val if env_val != default else head_cfg.get(
+                name, default)
+
+        threshold = float(knob("memory_monitor_threshold"))
+        interval = float(knob("memory_monitor_interval_s"))
+        test_file = str(knob("memory_monitor_test_file"))
+        if threshold <= 0:
+            return
+        while not self._stopped:
+            time.sleep(interval)
+            if self.conn is None:
+                continue
+            try:
+                frac = memmon.memory_usage_fraction(test_file)
+                if frac >= threshold:
+                    self._send(("oom_pressure", frac))
+            except Exception:
+                pass
 
     def _send(self, msg):
         with self.send_lock:
@@ -102,6 +142,8 @@ class NodeAgent:
         assert msg[0] == "agent_ack", msg
         self.node_id_hex = msg[1]
         self.session = msg[2]
+        # Head-pushed config this node mirrors (memory monitor knobs).
+        self.head_config = msg[3] if len(msg) > 3 else {}
         # Attach-only store for read_segment (segments here are created by
         # this node's workers; the agent never allocates).
         self.store = ShmStore(shm_dir=self.shm_dir, session_id=self.session)

@@ -28,6 +28,7 @@ import itertools
 import multiprocessing
 import multiprocessing.connection
 import os
+import sys
 import threading
 import time
 import weakref
@@ -164,6 +165,7 @@ class WorkerHandle:
         "ready", "dead", "outbox", "outbuf", "spawned_at",
         "lease_key", "lease_req", "lease_pg", "blocked",
         "pending_force_kill", "direct_addr", "client_lease",
+        "oom_killed", "last_dispatch_ts",
     )
 
     def __init__(self, worker_id, conn, proc, node, env_key, tpu_chips):
@@ -201,6 +203,10 @@ class WorkerHandle:
         # bypass it entirely — direct_task_transport.cc:568).
         self.direct_addr = None
         self.client_lease: Optional["WorkerHandle"] = None
+        # Memory-monitor bookkeeping: oom_killed types the death error;
+        # last_dispatch_ts picks the NEWEST task's worker as the victim.
+        self.oom_killed = False
+        self.last_dispatch_ts = 0.0
 
     def send(self, msg):
         with self.send_lock:
@@ -460,6 +466,9 @@ class Runtime:
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True, name="ray_tpu-reaper")
         self._reaper.start()
+        if config.memory_monitor_threshold > 0:
+            threading.Thread(target=self._memory_monitor_loop,
+                             daemon=True, name="ray_tpu-memmon").start()
         # Conflation sender: dispatches buffer exec/func messages per
         # worker; this thread flushes them as msg_batch frames.  While
         # one flush's pickle+write runs, later dispatches coalesce into
@@ -478,6 +487,11 @@ class Runtime:
         # count conservatively high).
         self._actor_tokens: Dict[bytes, bytes] = {}
         self._actor_tokens_consumed: set = set()
+        # Task execution spans (worker "spans" batches) + per-message-
+        # handler latency stats (reference: task events + event_stats.h).
+        self.task_spans: deque = deque(maxlen=200_000)
+        self._handler_stats: Dict[str, list] = {}
+        self._handler_stats_lock = threading.Lock()
         self._sender = threading.Thread(
             target=self._task_sender_loop, daemon=True,
             name="ray_tpu-sender")
@@ -1363,13 +1377,21 @@ class Runtime:
         # Actor creations get singleton classes: their worker becomes the
         # actor, so plain tasks must never pipeline onto its lease.
         marker = rec.actor_id if rec.is_actor_creation else None
-        # runtime_env is part of the class: env_vars are baked into the
-        # worker process at spawn, so tasks with different envs must never
-        # share a lease (reference: SchedulingKey includes runtime_env
-        # hash).
+        # runtime_env is part of the class: env_vars and the pip venv are
+        # baked into the worker process at spawn, so tasks with different
+        # envs must never share a lease (reference: SchedulingKey
+        # includes runtime_env hash).
         env = rec.spec.get("runtime_env") or {}
-        ekey = repr(sorted(env.get("env_vars", {}).items())) \
-            if env.get("env_vars") else None
+        ekey = None
+        if env.get("env_vars") or env.get("pip"):
+            parts = []
+            if env.get("env_vars"):
+                parts.append(repr(sorted(env["env_vars"].items())))
+            if env.get("pip"):
+                from ray_tpu._private.runtime_env_pip import pip_env_hash
+
+                parts.append("pip=" + pip_env_hash(env["pip"]))
+            ekey = "|".join(parts)
         return (tuple(sorted(rec.requirements.items())),
                 rec.pg_id, rec.bundle_index, skey, marker, ekey)
 
@@ -1468,6 +1490,7 @@ class Runtime:
         rec.node = worker.node
         rec.worker = worker
         rec.dispatched = True
+        worker.last_dispatch_ts = time.monotonic()
         if self._send_task(worker, rec):
             worker.inflight[rec.spec["task_id"]] = rec
         elif not worker.inflight:
@@ -1517,6 +1540,10 @@ class Runtime:
     def _env_key_for(self, rec: TaskRecord, tpu_chips) -> str:
         env = rec.spec.get("runtime_env") or {}
         key = repr(sorted(env.get("env_vars", {}).items()))
+        if env.get("pip"):
+            from ray_tpu._private.runtime_env_pip import pip_env_hash
+
+            key += f"|pip={pip_env_hash(env['pip'])}"
         if env.get("working_dir"):
             # Content hash, not path: edited directories must not reuse
             # idle workers that extracted the previous package.
@@ -1593,6 +1620,12 @@ class Runtime:
             if renv.get("working_dir"):
                 env["RAY_TPU_WORKING_DIR_PKG"] = \
                     self._package_working_dir(renv["working_dir"])
+            if renv.get("pip"):
+                # Worker builds/reuses the requirements venv and
+                # re-execs under it (runtime_env_pip.py).
+                import json as _json
+
+                env["RAY_TPU_PIP_SPEC"] = _json.dumps(renv["pip"])
         if tpu_chips:
             env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
             env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(tpu_chips)}"
@@ -1654,6 +1687,10 @@ class Runtime:
             if renv.get("working_dir"):
                 overrides["RAY_TPU_WORKING_DIR_PKG"] = \
                     self._package_working_dir(renv["working_dir"])
+            if renv.get("pip"):
+                import json as _json
+
+                overrides["RAY_TPU_PIP_SPEC"] = _json.dumps(renv["pip"])
         if tpu_chips:
             overrides["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
             overrides["TPU_CHIPS_PER_PROCESS_BOUNDS"] = \
@@ -1747,7 +1784,16 @@ class Runtime:
             # thread holding the lock may dispatch a spawn_worker to this
             # agent — the ack must be first on the wire (the agent's
             # handshake asserts it).
-            agent.send(("agent_ack", node.node_id.hex(), self.session_id))
+            # The ack carries head config the agent must mirror (the
+            # memory monitor's knobs — _system_config applies cluster-
+            # wide, not just to the head's own sampler).
+            agent.send(("agent_ack", node.node_id.hex(), self.session_id,
+                        {"memory_monitor_threshold":
+                             self.config.memory_monitor_threshold,
+                         "memory_monitor_interval_s":
+                             self.config.memory_monitor_interval_s,
+                         "memory_monitor_test_file":
+                             self.config.memory_monitor_test_file}))
         threading.Thread(target=self._agent_reader, args=(conn, agent),
                          daemon=True, name="ray_tpu-rx-agent").start()
         with self.lock:
@@ -2460,6 +2506,10 @@ class Runtime:
     def _handle_agent_msg(self, agent: AgentHandle, msg: tuple):
         if msg[0] == "segment":
             agent.deliver(msg[1], msg[2], msg[3])
+        elif msg[0] == "oom_pressure":
+            # The node's agent sampled its own memory over threshold;
+            # the victim policy runs here where the task table lives.
+            self._oom_kill_one(msg[1], node=agent.node)
 
     def _on_agent_death(self, agent: AgentHandle):
         """Node agent connection dropped: the node is gone (reference: GCS
@@ -2490,9 +2540,41 @@ class Runtime:
                     pass
 
     def _handle_worker_msg(self, worker: WorkerHandle, msg: tuple):
+        """Per-handler latency accounting wraps every control message
+        (reference: src/ray/common/event_stats.h — per-handler event
+        stats; this is the instrumentation that shows WHERE head time
+        goes under load)."""
+        t0 = time.perf_counter()
+        try:
+            return self._handle_worker_msg_inner(worker, msg)
+        finally:
+            dt = time.perf_counter() - t0
+            tag = msg[0] if isinstance(msg[0], str) else "?"
+            with self._handler_stats_lock:
+                s = self._handler_stats.get(tag)
+                if s is None:
+                    s = self._handler_stats[tag] = [0, 0.0, 0.0]
+                s[0] += 1
+                s[1] += dt
+                if dt > s[2]:
+                    s[2] = dt
+
+    def _handle_worker_msg_inner(self, worker: WorkerHandle, msg: tuple):
         tag = msg[0]
         if tag == "ready":
             worker.ready.set()
+        elif tag == "spans":
+            # Task execution spans from a worker (task events; feeds
+            # `ray_tpu.timeline()` — scripts.py:1840 `ray timeline`).
+            wid = worker.worker_id.hex()
+            nid = (worker.node.node_id.hex()
+                   if worker.node is not None else "")
+            with self.lock:
+                for tid_bin, name, start, end, kind in msg[1]:
+                    self.task_spans.append({
+                        "task_id": tid_bin.hex(), "name": name,
+                        "start": start, "end": end, "kind": kind,
+                        "worker_id": wid, "node_id": nid})
         elif tag == "event":
             # Generic worker->driver pubsub (reference: src/ray/pubsub/
             # long-poll channels) — used by train session streaming.
@@ -3166,11 +3248,18 @@ class Runtime:
                     self._enqueue_pending_locked(rec)
                 else:
                     self.tasks.pop(rec.spec["task_id"], None)
-                    err = (exc.TaskCancelledError(
-                               rec.spec.get("name", "task"))
-                           if rec.cancelled else exc.WorkerCrashedError(
-                               f"Worker died executing "
-                               f"{rec.spec.get('name', 'task')}"))
+                    if rec.cancelled:
+                        err = exc.TaskCancelledError(
+                            rec.spec.get("name", "task"))
+                    elif worker.oom_killed:
+                        err = exc.OutOfMemoryError(
+                            f"Task {rec.spec.get('name', 'task')} was "
+                            f"killed by the memory monitor (node memory "
+                            f"over threshold) and has no retries left")
+                    else:
+                        err = exc.WorkerCrashedError(
+                            f"Worker died executing "
+                            f"{rec.spec.get('name', 'task')}")
                     self._fail_task_locked(rec, err)
             self._dispatch_locked()
 
@@ -3225,6 +3314,74 @@ class Runtime:
             # must get a dispatch pass — without this, a task submitted
             # while the actor held the last slot pends forever.
             self._dispatch_locked()
+
+    # ----------------------------------------------------- memory monitor --
+    def _memory_monitor_loop(self):
+        """Kill one task worker per interval while node memory stays
+        above the threshold (reference: memory_monitor.h sampling +
+        worker_killing_policy_group_by_owner.cc — newest retriable task
+        first, so long-running work survives and the retry is cheap)."""
+        from ray_tpu._private import memmon
+
+        cfg = self.config
+        while not self._stopped:
+            time.sleep(cfg.memory_monitor_interval_s)
+            try:
+                frac = memmon.memory_usage_fraction(
+                    cfg.memory_monitor_test_file)
+            except Exception:
+                continue
+            if frac >= cfg.memory_monitor_threshold:
+                # This loop samples HEAD-node memory: victims must be
+                # head-local (remote nodes sample via their agent's
+                # oom_pressure, scoped the same way).
+                self._oom_kill_one(frac, node=self.head_node)
+
+    def _oom_kill_one(self, frac: float, node: Optional[NodeState] = None):
+        """Pick and kill the newest-dispatched plain-task worker (actors
+        and idle workers are never victims); its tasks retry via the
+        normal death path, typed OutOfMemoryError when retries run out."""
+        victim = None
+        with self.lock:
+            nodes = [node] if node is not None else list(
+                self.nodes.values())
+            best = -1.0
+            for nd in nodes:
+                for w in nd.all_workers.values():
+                    if (w.dead or w.oom_killed or w.actor_id is not None
+                            or not w.inflight):
+                        continue
+                    if any(rec.is_actor_creation
+                           for rec in w.inflight.values()):
+                        # actor_id is only set AFTER __init__ returns:
+                        # without this check the monitor would target
+                        # actors mid-creation (peak memory = exactly
+                        # when pressure fires), inverting the
+                        # actors-are-never-victims policy.
+                        continue
+                    if w.last_dispatch_ts > best:
+                        best = w.last_dispatch_ts
+                        victim = w
+            if victim is not None:
+                victim.oom_killed = True
+        if victim is None:
+            return
+        print(f"[ray_tpu] memory monitor: node usage {frac:.0%} >= "
+              f"{self.config.memory_monitor_threshold:.0%}, killing "
+              f"worker {victim.worker_id.hex()[:12]} "
+              f"({len(victim.inflight)} task(s) will retry)",
+              file=sys.stderr)
+        if victim.proc is not None:
+            try:
+                victim.proc.terminate()
+            except Exception:
+                pass
+        elif victim.node.agent is not None and not victim.node.agent.dead:
+            try:
+                victim.node.agent.send(
+                    ("kill_worker", victim.worker_id.hex()))
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- reaper --
     def _reap_loop(self):
@@ -3584,6 +3741,20 @@ class Runtime:
                                  for n in pg.reserved],
                     "removed": pg.removed,
                 } for pg in self.placement_groups.values()][:limit]
+        if kind == "spans":
+            with self.lock:
+                n = len(self.task_spans)
+                return list(itertools.islice(self.task_spans,
+                                             max(0, n - limit), None))
+        if kind == "handler_stats":
+            with self._handler_stats_lock:
+                return [{
+                    "handler": tag, "count": s[0],
+                    "total_ms": round(s[1] * 1e3, 3),
+                    "mean_us": round(s[1] / s[0] * 1e6, 1),
+                    "max_ms": round(s[2] * 1e3, 3),
+                } for tag, s in sorted(self._handler_stats.items(),
+                                       key=lambda kv: -kv[1][1])][:limit]
         raise ValueError(f"unknown state query kind {kind!r}")
 
     def list_nodes(self):

@@ -103,6 +103,11 @@ class _WorkerRuntime:
         # (reference: batched reply streams; kills per-task head wakeups).
         self._result_buf: list = []
         self._result_lock = threading.Lock()
+        # Task execution spans, shipped to the head in periodic batches
+        # (reference: task events / tracing_helper.py span injection —
+        # every task records submit->run->finish wall times; the head
+        # aggregates them for `ray timeline`).
+        self._span_buf: list = []
         # Set by worker_entry: True when no tasks are queued.  Results
         # buffer only while more work is queued behind them; a threaded
         # actor's lone reply must go out immediately, not on the 0.25s
@@ -223,6 +228,18 @@ class _WorkerRuntime:
             self._send(("result", e[0], e[1], e[2], e[3]))
         else:
             self._send(("result_batch", buf))
+
+    def record_span(self, task_id_bin: bytes, name: str, start: float,
+                    end: float, kind: str):
+        with self._result_lock:
+            self._span_buf.append((task_id_bin, name, start, end, kind))
+
+    def flush_spans(self):
+        with self._result_lock:
+            if not self._span_buf:
+                return
+            buf, self._span_buf = self._span_buf, []
+        self._send(("spans", buf))
 
     def flush_decrefs(self):
         head_bins = self._drain_decrefs()
@@ -702,11 +719,14 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
 
     Reference: _raylet.pyx:702 execute_task — deserialize args, invoke,
     store returns (small inline to owner, large to plasma/shm)."""
+    import time as _time
+
     task_id = TaskID(task["task_id"])
     dreply = task.pop("_dreply", None)
     rt.current_task_id = task_id
     num_returns = task["num_returns"]
     name = task.get("name", "task")
+    span_start = _time.time()
     try:
         args, kwargs = _load_args(rt, task)
         if "actor_id" in task:
@@ -748,6 +768,8 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
     finally:
         rt.current_task_id = None
         rt.current_actor_id = None
+        rt.record_span(task["task_id"], name, span_start, _time.time(),
+                       "actor_method" if "actor_id" in task else "task")
 
 
 def _pickle_error(err):
@@ -835,6 +857,13 @@ def main():
     from multiprocessing.connection import Client
 
     from multiprocessing import AuthenticationError
+
+    # runtime_env pip: build/reuse the requirements venv and re-exec
+    # under its interpreter BEFORE anything else loads (reference:
+    # _private/runtime_env/pip.py materialization).
+    from ray_tpu._private.runtime_env_pip import maybe_reexec_into_pip_env
+
+    maybe_reexec_into_pip_env()
 
     address = protocol.parse_address(os.environ["RAY_TPU_ADDRESS"])
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
@@ -1016,6 +1045,7 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                 # Bounds result-batch latency when a long task follows
                 # buffered short-task results.
                 rt.flush_results()
+                rt.flush_spans()
                 direct_server.flush_replies()
             except Exception:
                 return  # conn gone; reader exits the process

@@ -60,9 +60,15 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> str:
             "session_id": rt.session_id,
         })
 
+    async def api_timeline(request):
+        from ray_tpu.util.tracing import chrome_trace
+
+        return web.json_response(chrome_trace(rt.state_query("spans")))
+
     async def index(request):
         sections = ["cluster", "summary", "metrics", "jobs", "nodes",
-                    "actors", "tasks", "workers"]
+                    "actors", "tasks", "workers", "timeline",
+                    "handler_stats"]
         links = "".join(
             f'<li><a href="/api/{s}">/api/{s}</a></li>' for s in sections)
         return web.Response(
@@ -76,6 +82,7 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> str:
     app.router.add_get("/api/metrics", api_metrics)
     app.router.add_get("/api/jobs", api_jobs)
     app.router.add_get("/api/cluster", api_cluster)
+    app.router.add_get("/api/timeline", api_timeline)
     app.router.add_get("/api/{kind}", api_state)
 
     runner = web.AppRunner(app)

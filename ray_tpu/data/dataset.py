@@ -137,13 +137,6 @@ def _apply_op(op, block):
 
 
 @ray.remote
-def _apply_plan(ops, block):
-    for op in ops:
-        block = _apply_op(op, block)
-    return block
-
-
-@ray.remote
 def _count_block(block):
     return _block_len(block)
 
@@ -273,6 +266,8 @@ class Dataset:
         # re-run its UDF tasks (filled only when a consumer drains the
         # whole stream; partial reads like take/limit leave it unset).
         self._cached_refs: Optional[List[Any]] = None
+        # Per-operator accounting from the last execution (ds.stats()).
+        self._stats = None
 
     @classmethod
     def _from_segments(cls, segments: List[tuple]) -> "Dataset":
@@ -304,53 +299,115 @@ class Dataset:
     def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
         return self._with_op(("flat_map", fn))
 
-    def map_batches(self, fn: Callable, *, batch_format: str = "numpy"
-                    ) -> "Dataset":
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    compute: Optional[str] = None,
+                    concurrency: int = 2) -> "Dataset":
+        """``compute="actors"`` runs ``fn`` on a pool of long-lived
+        actors — a CLASS fn is instantiated once per actor, carrying
+        state (model weights etc.) across blocks (reference:
+        execution/operators/actor_pool_map_operator.py +
+        ActorPoolStrategy)."""
+        if compute == "actors":
+            from ray_tpu.data.execution import ACTOR_OP
+
+            return self._with_op((ACTOR_OP, fn, batch_format,
+                                  max(1, int(concurrency))))
+        if compute not in (None, "tasks"):
+            raise ValueError(f"compute must be 'tasks' or 'actors', "
+                             f"got {compute!r}")
         return self._with_op(("map_batches", fn, batch_format))
 
     # ------------------------------------------------------------- execution
     def _stream_refs(self, window: Optional[int] = None) -> Iterator[Any]:
         """Yield executed block refs in order, keeping at most ``window``
-        block tasks in flight — the streaming executor.  Blocks with no
-        pending ops pass straight through.  A fully-drained stream
-        memoizes its refs so repeat consumption reuses the results."""
+        blocks in flight end-to-end — the streaming executor
+        (streaming_executor.py:35 bounded admission).  The fused chain
+        splits into STAGES at actor-compute ops (execution.py); a
+        block's whole stage chain is submitted at once and pipelines on
+        dependency resolution.  Per-op stats accumulate on ``_stats``.
+        A fully-drained stream memoizes its refs."""
         if self._cached_refs is not None:
             yield from self._cached_refs
             return
+        from ray_tpu.data import execution as _ex
+
         window = window or DEFAULT_STREAMING_WINDOW
+        stats = self._stats = _ex.DatasetStats()
+        stats.note_start()
         pairs = ((b, ops) for blocks, ops in self._segments
                  for b in blocks)
+        pools: dict = {}  # id(actor op) -> ActorPoolMapOperator
+
+        def pool_for(op):
+            p = pools.get(id(op))
+            if p is None:
+                p = pools[id(op)] = _ex.ActorPoolMapOperator(
+                    op[1], op[2], op[3])
+            return p
 
         def submit(pair):
+            """Submit one block's full stage chain; returns
+            (final_ref, [(pool, actor_idx)...]) for inflight release."""
             b, ops = pair
-            return b if not ops else _apply_plan.remote(ops, b)
+            if not ops:
+                return b, ()
+            ref = b
+            done_notes = []
+            for kind, payload in _ex.split_stages(ops):
+                if kind == "actors":
+                    pool = pool_for(payload)
+                    ref, sref, ai = pool.submit((), ref)
+                    done_notes.append((pool, ai))
+                else:
+                    ref, sref = _ex.apply_stage_with_stats.remote(
+                        payload, ref)
+                stats.add_ref(sref)
+            return ref, tuple(done_notes)
 
         dq: deque = deque()
         it = iter(pairs)
         for pair in itertools.islice(it, window):
             dq.append(submit(pair))
         produced: List[Any] = []
-        while dq:
-            head = dq.popleft()
-            ray.wait([head], num_returns=1, timeout=None)
-            nxt = next(it, None)
-            if nxt is not None:
-                dq.append(submit(nxt))
-            produced.append(head)
-            yield head
-        self._cached_refs = produced
+        try:
+            while dq:
+                head, notes = dq.popleft()
+                ray.wait([head], num_returns=1, timeout=None)
+                for pool, ai in notes:
+                    pool.done(ai)
+                nxt = next(it, None)
+                if nxt is not None:
+                    dq.append(submit(nxt))
+                produced.append(head)
+                yield head
+            self._cached_refs = produced
+            stats.note_end()
+        finally:
+            for pool in pools.values():
+                pool.shutdown()
+
+    def stats(self) -> str:
+        """Per-operator execution summary of the last run (reference:
+        Dataset.stats() / _internal/stats.py)."""
+        from ray_tpu.data.execution import DatasetStats
+
+        return str(self._stats or DatasetStats())
 
     def materialize(self) -> "Dataset":
         """Execute the plan fully; the result holds plain block refs
-        (reference: Dataset.materialize)."""
+        (reference: Dataset.materialize).  Eager execution wants
+        THROUGHPUT, not bounded memory: the window opens to the full
+        block count so every execution slot in the cluster is used."""
         if self._cached_refs is not None:
             return Dataset(self._cached_refs)
         if all(not ops for _, ops in self._segments):
             return self
-        self._cached_refs = [
-            (b if not ops else _apply_plan.remote(ops, b))
-            for blocks, ops in self._segments for b in blocks]
-        return Dataset(self._cached_refs)
+        for _ in self._stream_refs(window=max(DEFAULT_STREAMING_WINDOW,
+                                              len(self._blocks))):
+            pass
+        out = Dataset(self._cached_refs)
+        out._stats = self._stats
+        return out
 
     def _executed_refs(self) -> List[Any]:
         return self.materialize()._blocks

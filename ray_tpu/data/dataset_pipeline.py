@@ -33,10 +33,20 @@ class DatasetPipeline:
     def flat_map(self, fn) -> "DatasetPipeline":
         return self._map_windows(lambda w: w.flat_map(fn))
 
-    def map_batches(self, fn, *, batch_format: str = "numpy"
+    def map_batches(self, fn, *, batch_format: str = "numpy",
+                    compute=None, concurrency: int = 2
                     ) -> "DatasetPipeline":
         return self._map_windows(
-            lambda w: w.map_batches(fn, batch_format=batch_format))
+            lambda w: w.map_batches(fn, batch_format=batch_format,
+                                    compute=compute,
+                                    concurrency=concurrency))
+
+    def stats(self) -> str:
+        """Concatenated per-window execution stats (reference:
+        DatasetPipeline.stats)."""
+        return "\n".join(
+            f"== window {i} ==\n{w.stats()}"
+            for i, w in enumerate(self._windows))
 
     def random_shuffle_each_window(self, *, seed=None) -> "DatasetPipeline":
         return self._map_windows(lambda w: w.random_shuffle(seed=seed))

@@ -1,0 +1,241 @@
+"""Streaming-execution internals: staged plans, the actor-pool map
+operator, and per-operator stats.
+
+Reference: ``python/ray/data/_internal/execution/streaming_executor.py:35``
+(operator graph with resource-budgeted admission),
+``execution/operators/actor_pool_map_operator.py`` (stateful UDFs on a
+pool of long-lived actors), and ``_internal/stats.py`` (per-op wall/rows
+accounting behind ``ds.stats()``).
+
+Design here: a fused op chain splits into STAGES at actor-compute ops —
+task stages run as one task per block (whole fused sub-chain), actor
+stages run on a lazily-created pool with least-loaded dispatch.  Every
+stage returns ``(block, stats)`` as two objects, so the tiny stats dicts
+can be collected without pulling blocks to the driver.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu as ray
+
+ACTOR_OP = "map_batches_actor"
+
+
+def split_stages(ops: tuple) -> List[Tuple[str, Any]]:
+    """Fused chain -> [("tasks", sub_ops) | ("actors", actor_op), ...]."""
+    stages: List[Tuple[str, Any]] = []
+    cur: list = []
+    for op in ops:
+        if op[0] == ACTOR_OP:
+            if cur:
+                stages.append(("tasks", tuple(cur)))
+                cur = []
+            stages.append(("actors", op))
+        else:
+            cur.append(op)
+    if cur:
+        stages.append(("tasks", tuple(cur)))
+    return stages
+
+
+def _est_bytes(block) -> int:
+    try:
+        import numpy as _np
+
+        if isinstance(block, dict):
+            return sum(v.nbytes if isinstance(v, _np.ndarray)
+                       else len(v) * 8 for v in block.values())
+        if isinstance(block, _np.ndarray):
+            return block.nbytes
+        if hasattr(block, "nbytes"):  # pyarrow.Table
+            return int(block.nbytes)
+        return len(block) * 64  # rows-of-dicts rough estimate
+    except Exception:
+        return 0
+
+
+@ray.remote(num_returns=2)
+def apply_stage_with_stats(ops: tuple, block):
+    """Run a fused task-stage over one block; second return is the per-op
+    stats list (kept tiny so stats collection never moves block data)."""
+    from ray_tpu.data.dataset import _apply_op, _block_len
+
+    stats = []
+    for op in ops:
+        t0 = time.perf_counter()
+        block = _apply_op(op, block)
+        stats.append({"op": op[0], "wall_s": time.perf_counter() - t0,
+                      "rows_out": _block_len(block),
+                      "bytes_out": _est_bytes(block)})
+    return block, stats
+
+
+@ray.remote
+class _MapWorker:
+    """One actor of the pool (reference: actor_pool_map_operator.py's
+    MapWorker).  A CLASS fn is instantiated once here — that instance
+    carries the user's state (model weights, connections) across
+    blocks, which is the entire point of compute="actors"."""
+
+    def __init__(self, fn, batch_format: str):
+        self._fn = fn() if isinstance(fn, type) else fn
+        self._batch_format = batch_format
+
+    def ready(self):
+        return True
+
+    def apply(self, prior_ops: tuple, block):
+        from ray_tpu.data.dataset import _apply_op, _block_len
+
+        stats = []
+        for op in prior_ops:
+            t0 = time.perf_counter()
+            block = _apply_op(op, block)
+            stats.append({"op": op[0],
+                          "wall_s": time.perf_counter() - t0,
+                          "rows_out": _block_len(block),
+                          "bytes_out": _est_bytes(block)})
+        t0 = time.perf_counter()
+        block = _apply_op(("map_batches", self._fn, self._batch_format),
+                          block)
+        stats.append({"op": "map_batches(actors)",
+                      "wall_s": time.perf_counter() - t0,
+                      "rows_out": _block_len(block),
+                      "bytes_out": _est_bytes(block)})
+        return block, stats
+
+
+class ActorPoolMapOperator:
+    """Least-loaded dispatch over ``size`` map workers (reference:
+    actor_pool_map_operator.py + the autoscaling ActorPool — fixed size
+    here; blocks queue on the least-busy worker).
+
+    Dispatch only targets actors whose __init__ completed: on a cluster
+    with fewer free CPUs than ``size``, the unscheduled actors simply
+    never receive blocks (the reference's pool likewise scales to what
+    actually got placed) — statically round-robining onto a never-
+    scheduled actor would hang the stream."""
+
+    _STRAGGLER_GRACE_S = 10.0
+
+    def __init__(self, fn, batch_format: str, size: int):
+        # Never reserve the whole cluster: upstream task stages need at
+        # least one slot or the stream deadlocks (pool actors waiting on
+        # input refs whose producing tasks can never schedule).
+        try:
+            total_cpu = int(ray.cluster_resources().get("CPU", size + 1))
+            size = max(1, min(size, total_cpu - 1))
+        except Exception:
+            pass
+        self._actors = [
+            _MapWorker.options(num_cpus=1).remote(fn, batch_format)
+            for _ in range(max(1, size))]
+        self._inflight = [0] * len(self._actors)
+        self._ready_refs = [a.ready.remote() for a in self._actors]
+        self._ready = [False] * len(self._actors)
+        # Unscheduled actors get this long to come up while the ready
+        # ones are busy; after that, dispatch permanently ignores them.
+        self._grace_deadline = time.monotonic() + self._STRAGGLER_GRACE_S
+
+    def _ready_indices(self) -> List[int]:
+        pending = [(i, r) for i, r in enumerate(self._ready_refs)
+                   if not self._ready[i]]
+        if pending:
+            done, _ = ray.wait([r for _, r in pending],
+                               num_returns=len(pending), timeout=0)
+            done_set = set(done)
+            for i, r in pending:
+                if r in done_set:
+                    self._ready[i] = True
+        out = [i for i, ok in enumerate(self._ready) if ok]
+        if not out:
+            # No actor placed yet: block for the FIRST one (at least one
+            # must eventually schedule or the workload is infeasible).
+            ray.wait(self._ready_refs, num_returns=1, timeout=None)
+            return self._ready_indices()
+        return out
+
+    def submit(self, prior_ops: tuple, block_ref):
+        ready = self._ready_indices()
+        while (len(ready) < len(self._actors)
+               and min(self._inflight[i] for i in ready) > 0
+               and time.monotonic() < self._grace_deadline):
+            # The placed actors are all busy and stragglers may still
+            # schedule: give them a beat instead of piling onto one.
+            pending = [r for i, r in enumerate(self._ready_refs)
+                       if not self._ready[i]]
+            ray.wait(pending, num_returns=1, timeout=0.2)
+            ready = self._ready_indices()
+        i = min(ready, key=self._inflight.__getitem__)
+        self._inflight[i] += 1
+        block, stats = self._actors[i].apply.options(num_returns=2).remote(
+            prior_ops, block_ref)
+        return block, stats, i
+
+    def done(self, i: int):
+        self._inflight[i] = max(0, self._inflight[i] - 1)
+
+    def shutdown(self):
+        for a in self._actors:
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+
+class DatasetStats:
+    """Aggregated per-operator accounting behind ``ds.stats()``
+    (reference: _internal/stats.py DatasetStatsSummary)."""
+
+    def __init__(self):
+        self._ops: Dict[str, Dict[str, float]] = {}
+        self._stats_refs: List[Any] = []
+        self._wall_start: Optional[float] = None
+        self._wall_end: Optional[float] = None
+
+    def note_start(self):
+        if self._wall_start is None:
+            self._wall_start = time.perf_counter()
+
+    def note_end(self):
+        self._wall_end = time.perf_counter()
+
+    def add_ref(self, stats_ref):
+        self._stats_refs.append(stats_ref)
+
+    def _drain(self):
+        if not self._stats_refs:
+            return
+        refs, self._stats_refs = self._stats_refs, []
+        for per_block in ray.get(refs):
+            for s in per_block:
+                agg = self._ops.setdefault(
+                    s["op"], {"blocks": 0, "wall_s": 0.0, "rows_out": 0,
+                              "bytes_out": 0})
+                agg["blocks"] += 1
+                agg["wall_s"] += s["wall_s"]
+                agg["rows_out"] += s["rows_out"]
+                agg["bytes_out"] += s["bytes_out"]
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        self._drain()
+        return {k: dict(v) for k, v in self._ops.items()}
+
+    def __str__(self) -> str:
+        self._drain()
+        lines = []
+        if self._wall_start is not None and self._wall_end is not None:
+            lines.append(
+                f"Dataset execution: "
+                f"{self._wall_end - self._wall_start:.3f}s wall")
+        for op, agg in self._ops.items():
+            mb = agg["bytes_out"] / 1e6
+            lines.append(
+                f"  {op}: {agg['blocks']} blocks, "
+                f"{agg['wall_s'] * 1e3:.1f}ms task time, "
+                f"{int(agg['rows_out'])} rows out, {mb:.2f}MB out")
+        return "\n".join(lines) or "Dataset: no execution recorded"

@@ -74,6 +74,13 @@ class WorkerCrashedError(RayTpuError):
     (reference: WORKER_DIED error type in common.proto)."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The memory monitor killed the task's worker under node memory
+    pressure and its retries are exhausted (reference:
+    src/ray/common/memory_monitor.h + OUT_OF_MEMORY error type —
+    kill retriable tasks before the kernel OOM-killer takes the node)."""
+
+
 class ObjectLostError(RayTpuError):
     """Object's value is unrecoverable (owner gone, store evicted and no
     lineage)."""

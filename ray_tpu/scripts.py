@@ -106,6 +106,96 @@ def _cmd_stop(args):
     print(client.stop_job(args.job_id))
 
 
+def _cmd_head(args):
+    """Run a head process until SIGTERM (the launcher's `ray start
+    --head` analog: fixed port + authkey so agents and clients can
+    dial)."""
+    import signal as _signal
+    import time as _time
+
+    import ray_tpu as ray
+
+    rt = ray.init(num_cpus=float(args.num_cpus),
+                  _system_config={"authkey_hex": args.authkey,
+                                  "listen_port": int(args.port),
+                                  "listen_host": args.host})
+    print(f"head up at {rt.tcp_address}", flush=True)
+    stop = {"flag": False}
+    _signal.signal(_signal.SIGTERM,
+                   lambda *_: stop.__setitem__("flag", True))
+    try:
+        while not stop["flag"]:
+            _time.sleep(0.5)
+    finally:
+        ray.shutdown()
+
+
+def _cmd_up(args):
+    from ray_tpu.autoscaler.launcher import up
+
+    up(args.config)
+
+
+def _cmd_down(args):
+    from ray_tpu.autoscaler.launcher import down
+
+    down(args.config)
+
+
+def _cmd_exec(args):
+    import shlex
+
+    from ray_tpu.autoscaler.launcher import exec_cmd
+
+    entry = args.cmd
+    if entry and entry[0] == "--":
+        entry = entry[1:]
+    # shlex re-quoting: argv tokens with spaces/metachars must survive
+    # the shell=True round trip intact.
+    sys.exit(exec_cmd(args.config,
+                      " ".join(shlex.quote(t) for t in entry)))
+
+
+def _cmd_attach(args):
+    from ray_tpu.autoscaler.launcher import attach
+
+    sys.exit(attach(args.config))
+
+
+def _cmd_timeline(args):
+    """``ray timeline`` analog (reference: scripts.py:1840): dump the
+    cluster's task spans as chrome://tracing / Perfetto JSON."""
+    rt = _client(args)
+    try:
+        spans = rt.request(
+            lambda rid: ("state_req", rid, "spans", {"limit": 200000}))
+        if isinstance(spans, Exception):
+            raise spans
+        from ray_tpu.util.tracing import chrome_trace
+
+        events = chrome_trace(spans)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(events, f)
+        print(f"wrote {len(events)} events to {args.out}")
+    finally:
+        rt.disconnect()
+
+
+def _cmd_handler_stats(args):
+    rt = _client(args)
+    try:
+        stats = rt.request(
+            lambda rid: ("state_req", rid, "handler_stats", {}))
+        if isinstance(stats, Exception):
+            raise stats
+        for s in stats:
+            print(f"{s['handler']:>18}  n={s['count']:<8} "
+                  f"mean={s['mean_us']:>8.1f}us  max={s['max_ms']:>7.2f}ms "
+                  f" total={s['total_ms']:.1f}ms")
+    finally:
+        rt.disconnect()
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu",
                                 description=__doc__.splitlines()[0])
@@ -151,6 +241,40 @@ def main(argv=None):
     common(sp)
     sp.add_argument("job_id")
     sp.set_defaults(fn=_cmd_stop)
+
+    hd = sub.add_parser(
+        "head", help="run a head process (fixed port + authkey)")
+    hd.add_argument("--num-cpus", type=float, default=4.0)
+    hd.add_argument("--port", type=int, required=True)
+    hd.add_argument("--authkey", required=True)
+    hd.add_argument("--host", default="127.0.0.1")
+    hd.set_defaults(fn=_cmd_head)
+
+    for cname, fn, extra in (("up", _cmd_up, None),
+                             ("down", _cmd_down, None),
+                             ("attach", _cmd_attach, None)):
+        cp = sub.add_parser(
+            cname, help=f"{cname} a cluster from a YAML config "
+                        f"(launcher; reference: ray {cname})")
+        cp.add_argument("config")
+        cp.set_defaults(fn=fn)
+
+    ex = sub.add_parser(
+        "exec", help="run a shell command wired to a launched cluster")
+    ex.add_argument("config")
+    ex.add_argument("cmd", nargs=argparse.REMAINDER)
+    ex.set_defaults(fn=_cmd_exec)
+
+    tl = sub.add_parser(
+        "timeline", help="dump task timeline as Chrome trace JSON")
+    common(tl)
+    tl.add_argument("--out", default="ray_tpu_timeline.json")
+    tl.set_defaults(fn=_cmd_timeline)
+
+    hs = sub.add_parser(
+        "handler-stats", help="head per-message-handler latency stats")
+    common(hs)
+    hs.set_defaults(fn=_cmd_handler_stats)
 
     args = p.parse_args(argv)
     args.fn(args)

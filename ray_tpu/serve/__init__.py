@@ -16,14 +16,16 @@ replica actor, the Serve layer needs no device code.
 from ray_tpu.serve.api import (
     Deployment,
     DeploymentHandle,
+    HTTPProxyActor,
     deployment,
     get_deployment_handle,
     run,
     shutdown,
+    start,
     start_http_proxy,
 )
 from ray_tpu.serve.batching import batch
 
 __all__ = ["deployment", "Deployment", "DeploymentHandle", "run",
-           "get_deployment_handle", "shutdown", "start_http_proxy",
-           "batch"]
+           "get_deployment_handle", "shutdown", "start",
+           "start_http_proxy", "HTTPProxyActor", "batch"]

@@ -57,6 +57,11 @@ class ServeController:
         self._deployments: Dict[str, Dict[str, Any]] = {}
         # name -> list of {"actor": handle, "version": int}
         self._replicas: Dict[str, List[Dict[str, Any]]] = {}
+        # route prefix -> deployment name: controller-resident so EVERY
+        # node's proxy serves the same routing table (reference: the
+        # proxy's route table long-polled from the controller,
+        # _private/http_proxy.py + long_poll.py ROUTE_TABLE key).
+        self._routes: Dict[str, str] = {}
         # autoscaling inputs: (name, handle_id) -> (ongoing, monotonic ts)
         self._handle_metrics: Dict[tuple, tuple] = {}
         self._last_scale_up: Dict[str, float] = {}
@@ -127,6 +132,11 @@ class ServeController:
         with self._lock:
             self._deployments.pop(name, None)
             reps = self._replicas.pop(name, [])
+            # Routes to a deleted deployment 404 (proxies refresh the
+            # table within their TTL) instead of erroring forever.
+            for prefix in [p for p, n in self._routes.items()
+                           if n == name]:
+                self._routes.pop(prefix, None)
             self._bump_version_locked(name)
         for r in reps:
             try:
@@ -300,6 +310,15 @@ class ServeController:
                         "version": d.get("version", 1),
                         "autoscaling": bool(d.get("autoscaling_config"))}
                     for n, d in self._deployments.items()}
+
+    def set_route(self, prefix: str, name: str):
+        with self._lock:
+            self._routes[prefix] = name
+        return True
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
 
     def scale(self, name: str, num_replicas: int):
         with self._lock:
@@ -535,6 +554,9 @@ def run(target: Deployment, *, name: Optional[str] = None
         "num_tpus": target.num_tpus,
         "autoscaling_config": target.autoscaling_config,
     }))
+    # Route registered at the CONTROLLER so every node's proxy serves it
+    # (the driver-thread proxy keeps its local copy too).
+    ray.get(controller.set_route.remote(target.route_prefix, dep_name))
     handle = DeploymentHandle(dep_name, controller)
     _state["handles"][dep_name] = handle
     _state["routes"][target.route_prefix] = handle
@@ -594,7 +616,146 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000):
     return f"http://{host}:{port}"
 
 
+@ray.remote
+class HTTPProxyActor:
+    """Per-node HTTP ingress (reference: one HTTPProxyActor per node,
+    _private/http_proxy.py:415 + proxy_state_manager).  Routes come from
+    the controller's table; replica routing rides this proxy's own
+    DeploymentHandles (push-updated, least-loaded) — requests never
+    touch the driver."""
+
+    _ROUTE_TTL_S = 2.0
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import asyncio
+
+        from aiohttp import web
+
+        self._controller = ray.get_actor(CONTROLLER_NAME)
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes: Dict[str, str] = {}
+        self._routes_ts = 0.0
+        self._routes_lock = threading.Lock()
+
+        def call_sync(path: str, body):
+            """Route lookup + handle construction + replica call: every
+            step may RPC the controller, so the WHOLE chain runs in the
+            executor — any blocking call on the event loop would
+            serialize this proxy's request stream."""
+            dep = self._route_for(path)
+            if dep is None:
+                return None  # distinct from ("ok", None): a None RESULT
+            h = self._handles.get(dep)
+            if h is None:
+                h = self._handles[dep] = DeploymentHandle(
+                    dep, self._controller)
+            return ("ok", ray.get(h.remote(body)))
+
+        async def handle(request: web.Request):
+            path = "/" + request.path.strip("/").split("/")[0]
+            try:
+                body = await request.json() if request.can_read_body \
+                    else {}
+            except Exception:
+                body = {}
+            loop = asyncio.get_event_loop()
+            out = await loop.run_in_executor(None, call_sync, path, body)
+            if out is None:
+                return web.json_response({"error": "no such route"},
+                                         status=404)
+            return web.json_response({"result": out[1]})
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handle)
+        runner = web.AppRunner(app)
+        ready = threading.Event()
+        state: Dict[str, Any] = {}
+
+        def serve_thread():
+            try:
+                loop = asyncio.new_event_loop()
+                asyncio.set_event_loop(loop)
+                loop.run_until_complete(runner.setup())
+                site = web.TCPSite(runner, host, port)
+                loop.run_until_complete(site.start())
+                state["port"] = site._server.sockets[0].getsockname()[1]
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                state["error"] = e
+                ready.set()
+                return
+            ready.set()
+            loop.run_forever()
+
+        threading.Thread(target=serve_thread, daemon=True,
+                         name="serve-proxy").start()
+        if not ready.wait(15):
+            raise RuntimeError("proxy HTTP server failed to start (15s)")
+        if "error" in state:
+            raise RuntimeError(
+                f"proxy HTTP server failed to start on "
+                f"{host}:{port}") from state["error"]
+        self._url = f"http://{host}:{state['port']}"
+
+    def _route_for(self, path: str) -> Optional[str]:
+        now = time.monotonic()
+        with self._routes_lock:
+            stale = now - self._routes_ts > self._ROUTE_TTL_S
+            dep = self._routes.get(path)
+        if stale:
+            # Refresh on TTL only: unknown paths stay negative-cached
+            # until then, so a 404 flood cannot serialize requests on
+            # controller RPCs.
+            routes = ray.get(self._controller.get_routes.remote())
+            with self._routes_lock:
+                self._routes = routes
+                self._routes_ts = now
+                dep = routes.get(path)
+        return dep
+
+    def url(self) -> str:
+        return self._url
+
+    def node_id(self) -> str:
+        import ray_tpu
+
+        return ray_tpu.get_runtime_context().node_id
+
+
+def start(proxy_location: str = "HeadOnly", http_options: Optional[
+        Dict[str, Any]] = None) -> List[str]:
+    """Start Serve ingress (reference: serve.start(proxy_location=...) —
+    ProxyLocation.EveryNode runs one proxy per node).  Returns the proxy
+    URLs."""
+    http_options = http_options or {}
+    host = http_options.get("host", "127.0.0.1")
+    port = int(http_options.get("port", 0))
+    _get_controller()
+    if proxy_location != "EveryNode":
+        return [start_http_proxy(host, port or 8000)]
+    proxies = []
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    for n in ray.nodes():
+        if not n.get("alive", True):
+            continue
+        p = HTTPProxyActor.options(
+            num_cpus=0,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                n["node_id"], soft=False)).remote(host, port)
+        proxies.append(p)
+    urls = ray.get([p.url.remote() for p in proxies])
+    _state["node_proxies"] = proxies
+    return urls
+
+
 def shutdown():
+    for p in _state.pop("node_proxies", []) or []:
+        try:
+            ray.kill(p)
+        except Exception:
+            pass
     if _state["controller"] is not None:
         try:
             for name in list(

@@ -1,0 +1,103 @@
+"""Cluster launcher (reference: ray up/down/exec, autoscaler/_private/
+commands.py) with the subprocess provider — real head process + real
+node-agent subprocesses over TCP."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cluster_cfg(tmp_path, monkeypatch):
+    import ray_tpu.autoscaler.launcher as launcher
+
+    monkeypatch.setattr(launcher, "STATE_DIR", str(tmp_path / "state"))
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text(textwrap.dedent(f"""
+        cluster_name: t-{os.getpid()}
+        provider:
+          type: subprocess
+        head:
+          num_cpus: 2
+        worker_types:
+          cpu-2:
+            resources: {{CPU: 2}}
+            min_workers: 1
+            max_workers: 2
+    """))
+    yield str(cfg), launcher
+    try:
+        launcher.down(str(cfg))
+    except Exception:
+        pass
+
+
+def test_up_exec_down(cluster_cfg):
+    cfg_path, launcher = cluster_cfg
+    state = launcher.up(cfg_path)
+    assert state["address"].startswith("tcp://")
+    assert len(state["nodes"]) == 1
+
+    # exec: a driver script connecting through the env the launcher sets,
+    # seeing BOTH nodes (head + subprocess agent).
+    script = os.path.join(os.path.dirname(cfg_path), "probe.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent("""
+            import json
+            import os
+            import sys
+
+            from ray_tpu._private.client import client_connect
+
+            rt = client_connect(os.environ["RAY_TPU_ADDRESS"],
+                                bytes.fromhex(
+                                    os.environ["RAY_TPU_CLIENT_AUTHKEY"]))
+            info = rt.request(lambda rid: ("cluster_info", rid))
+            print(json.dumps({"nodes": len(info["nodes"]),
+                              "cpus": info["resources"].get("CPU")}))
+            rt.disconnect()
+        """))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               RAY_TPU_ADDRESS=state["address"],
+               RAY_TPU_CLIENT_AUTHKEY=state["authkey"])
+    deadline_tries = 20
+    for _ in range(deadline_tries):  # agent registration is async
+        out = subprocess.run([sys.executable, script], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        info = json.loads(out.stdout.strip().splitlines()[-1])
+        if info["nodes"] >= 2:
+            break
+        import time
+        time.sleep(0.5)
+    assert info["nodes"] == 2, info
+    assert info["cpus"] == 4.0  # head 2 + worker node 2
+
+    # exec_cmd wires the same env through a shell.
+    rc = launcher.exec_cmd(cfg_path,
+                           f"{sys.executable} {script} > /dev/null")
+    assert rc == 0
+
+    # idempotent up
+    state2 = launcher.up(cfg_path)
+    assert state2["address"] == state["address"]
+
+    launcher.down(cfg_path)
+    import time
+
+    def head_dead(pid):
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return f.read().split()[2] == "Z"  # zombie child
+        except OSError:
+            return True  # reaped / gone
+
+    deadline = time.time() + 10
+    while time.time() < deadline and not head_dead(state["head_pid"]):
+        time.sleep(0.3)
+    assert head_dead(state["head_pid"])

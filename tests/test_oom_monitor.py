@@ -1,0 +1,77 @@
+"""OOM memory monitor (reference: src/ray/common/memory_monitor.h +
+worker_killing_policy_group_by_owner.cc): under node memory pressure the
+newest retriable task's worker is killed and the task retries; exhausted
+retries surface a typed OutOfMemoryError."""
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import exceptions as exc
+
+
+@pytest.fixture
+def pressure_file(tmp_path):
+    p = tmp_path / "pressure"
+    p.write_text("0.0")
+    return p
+
+
+@pytest.fixture
+def oom_cluster(pressure_file):
+    ray.init(num_cpus=2, ignore_reinit_error=True, _system_config={
+        "memory_monitor_test_file": str(pressure_file),
+        "memory_monitor_interval_s": 0.15,
+        "memory_monitor_threshold": 0.9,
+    })
+    yield pressure_file
+    ray.shutdown()
+
+
+def test_task_killed_then_retried(oom_cluster):
+    pressure = oom_cluster
+
+    @ray.remote(max_retries=4)
+    def slow():
+        import time
+        time.sleep(1.2)
+        return "survived"
+
+    ref = slow.remote()
+    time.sleep(0.3)            # task is running
+    pressure.write_text("0.97")  # monitor kills its worker
+    time.sleep(0.5)
+    pressure.write_text("0.1")   # pressure gone; retry must complete
+    assert ray.get(ref, timeout=60) == "survived"
+
+
+def test_exhausted_retries_surface_typed_error(oom_cluster):
+    pressure = oom_cluster
+
+    @ray.remote(max_retries=0)
+    def victim():
+        import time
+        time.sleep(30)
+
+    ref = victim.remote()
+    time.sleep(0.3)
+    pressure.write_text("0.97")
+    with pytest.raises(exc.OutOfMemoryError):
+        ray.get(ref, timeout=30)
+    pressure.write_text("0.0")
+
+
+def test_actors_are_never_victims(oom_cluster):
+    pressure = oom_cluster
+
+    @ray.remote
+    class Keeper:
+        def ping(self):
+            return "alive"
+
+    k = Keeper.remote()
+    assert ray.get(k.ping.remote(), timeout=30) == "alive"
+    pressure.write_text("0.97")
+    time.sleep(0.6)
+    pressure.write_text("0.0")
+    assert ray.get(k.ping.remote(), timeout=30) == "alive"

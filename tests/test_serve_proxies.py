@@ -1,0 +1,57 @@
+"""Per-node Serve proxies (reference: serve.start(proxy_location=
+"EveryNode") — one HTTPProxyActor per node, _private/http_proxy.py:415;
+routing state shared via the controller's route table)."""
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import serve
+
+
+@pytest.fixture
+def two_node_cluster():
+    rt = ray.init(num_cpus=2)
+    rt.add_node(num_cpus=2)
+    yield rt
+    serve.shutdown()
+    ray.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_every_node_proxies_serve_requests(two_node_cluster):
+    @serve.deployment(num_replicas=2, route_prefix="/echo")
+    def echo(body):
+        return {"echo": body.get("x", 0) * 2}
+
+    urls = serve.start(proxy_location="EveryNode")
+    assert len(urls) == 2, urls
+    assert len(set(urls)) == 2  # distinct ports (in-process nodes)
+
+    serve.run(echo)
+    for i, url in enumerate(urls):
+        out = _post(url + "/echo", {"x": 10 + i})
+        assert out["result"]["echo"] == (10 + i) * 2, (url, out)
+
+    # Unknown route 404s on every proxy.
+    for url in urls:
+        try:
+            _post(url + "/nope", {})
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+
+def test_proxies_land_on_distinct_nodes(two_node_cluster):
+    serve.start(proxy_location="EveryNode")
+    proxies = serve.api._state["node_proxies"]
+    nodes = ray.get([p.node_id.remote() for p in proxies])
+    assert len(set(nodes)) == 2, nodes
