@@ -93,6 +93,7 @@ def client_connect(address: str, authkey: bytes,
     for attempt in range(20):
         try:
             conn = _Dial(addr, authkey=authkey)
+            protocol.enable_nodelay(conn)
             break
         except (ConnectionError, OSError) as e:
             err = e

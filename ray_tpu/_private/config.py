@@ -126,6 +126,12 @@ class Config:
     # /proc/meminfo (reference tests inject usage the same way).
     memory_monitor_test_file: str = ""
 
+    # Stream worker stdout/stderr to the driver with a worker prefix
+    # (reference: log_monitor.py + log_to_driver in ray.init).  Worker
+    # output always lands in per-worker files under the session dir;
+    # this flag controls the re-print at the driver.
+    log_to_driver: bool = True
+
     @classmethod
     def from_env(cls, overrides: dict | None = None) -> "Config":
         kwargs = {}

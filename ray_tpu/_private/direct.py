@@ -1251,6 +1251,7 @@ class DirectServer:
         while not self._stopped:
             try:
                 conn = self._listener.accept()
+                protocol.enable_nodelay(conn)
             except Exception:
                 if self._stopped:
                     return

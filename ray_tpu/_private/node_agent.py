@@ -75,6 +75,27 @@ class NodeAgent:
                          name="agent-objsrv").start()
         threading.Thread(target=self._memory_monitor, daemon=True,
                          name="agent-memmon").start()
+        threading.Thread(target=self._log_tailer, daemon=True,
+                         name="agent-logmon").start()
+
+    def _log_tailer(self):
+        """Ship this node's worker log lines to the head in 0.5s batches
+        (the remote half of the driver's log_monitor)."""
+        from ray_tpu._private.logtail import tail_worker_logs
+
+        log_dir = os.path.join(self.shm_dir, "logs")
+        offsets: Dict[str, int] = {}
+        partial: Dict[str, bytes] = {}
+        while not self._stopped:
+            time.sleep(0.5)
+            if self.conn is None:
+                continue
+            batch = tail_worker_logs(log_dir, offsets, partial)
+            if batch:
+                try:
+                    self._send(("worker_logs", batch))
+                except Exception:
+                    pass
 
     def _memory_monitor(self):
         """Sample this node's memory; over threshold, report pressure to
@@ -123,6 +144,7 @@ class NodeAgent:
         for attempt in range(40):
             try:
                 self.conn = Client(addr, authkey=self.authkey)
+                protocol.enable_nodelay(self.conn)
                 break
             except (ConnectionError, OSError):
                 time.sleep(0.1 * (attempt + 1))
@@ -152,6 +174,7 @@ class NodeAgent:
         while not self._stopped:
             try:
                 conn = self._obj_listener.accept()
+                protocol.enable_nodelay(conn)
             except Exception:
                 if self._stopped:
                     return
@@ -250,9 +273,17 @@ class NodeAgent:
         existing = env.get("PYTHONPATH", "")
         env["PYTHONPATH"] = (pkg_root + (os.pathsep + existing
                                          if existing else ""))
+        # Per-worker log file; the agent's tailer ships new lines to the
+        # head (reference: per-node log_monitor shipping to the driver).
+        log_dir = os.path.join(self.shm_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_f = open(os.path.join(log_dir, f"worker-{worker_id_hex}.log"),
+                     "ab", buffering=0)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, cwd=pkg_root)
+            env=env, cwd=pkg_root, stdout=log_f,
+            stderr=subprocess.STDOUT)
+        log_f.close()
         self.workers[worker_id_hex] = proc
 
     def _kill_worker(self, worker_id_hex: str):

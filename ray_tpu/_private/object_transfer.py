@@ -93,6 +93,7 @@ class ObjectPuller:
         from multiprocessing.connection import Client
 
         conn = Client(protocol.parse_address(addr), authkey=self._authkey)
+        protocol.enable_nodelay(conn)
         ent = (conn, threading.Lock())
         with self._lock:
             # A racing dialer may have won; keep one, close the other.

@@ -48,7 +48,37 @@ the reference speaks gRPC for both (``node_manager.proto``).
 
 from __future__ import annotations
 
+import os
 import pickle
+
+
+def enable_nodelay(conn) -> None:
+    """Disable Nagle on a TCP connection (no-op for AF_UNIX pipes).
+
+    The protocol often issues back-to-back small sends on one socket
+    (blocked + mget, decref_batch + submit); with Nagle on, the second
+    write stalls until the peer's delayed ACK (~40ms) — the classic
+    Nagle/delayed-ACK interaction that collapsed client-mode gets to
+    ~26/s.  The reference's gRPC channels disable Nagle the same way."""
+    import socket as _socket
+
+    try:
+        fd = os.dup(conn.fileno())
+    except (OSError, AttributeError):
+        return
+    try:
+        s = _socket.socket(fileno=fd)
+    except OSError:
+        os.close(fd)
+        return
+    try:
+        # Options bind to the open file description, which the original
+        # connection shares with this dup.
+        s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # AF_UNIX
+    finally:
+        s.close()
 
 
 def send(conn, msg: tuple):

@@ -469,6 +469,11 @@ class Runtime:
         if config.memory_monitor_threshold > 0:
             threading.Thread(target=self._memory_monitor_loop,
                              daemon=True, name="ray_tpu-memmon").start()
+        # Worker log rings (worker_id_hex -> recent lines) + the tailer
+        # that feeds them and re-prints to the driver (log_monitor.py).
+        self._worker_logs: Dict[str, deque] = {}
+        threading.Thread(target=self._log_monitor_loop, daemon=True,
+                         name="ray_tpu-logmon").start()
         # Conflation sender: dispatches buffer exec/func messages per
         # worker; this thread flushes them as msg_batch frames.  While
         # one flush's pickle+write runs, later dispatches coalesce into
@@ -1667,9 +1672,19 @@ class Runtime:
             "RAY_TPU_SPILL_DIR_OVERRIDE": self.spill_dir,
         })
         env["RAY_TPU_STORE_ID"] = self.store_id
+        # Worker output goes to a per-worker file (reference: workers log
+        # under the session dir; log_monitor.py tails them to the
+        # driver).  The head's monitor thread re-prints new lines with a
+        # worker prefix when log_to_driver is on.
+        log_dir = os.path.join(self._sock_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_f = open(os.path.join(log_dir, f"worker-{worker_id.hex()}.log"),
+                     "ab", buffering=0)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, cwd=pkg_root)
+            env=env, cwd=pkg_root, stdout=log_f,
+            stderr=subprocess.STDOUT)
+        log_f.close()  # the child holds its own fd
         w = WorkerHandle(worker_id, None, proc, node, env_key, tpu_chips)
         node.all_workers[id(w)] = w
         self._pending_workers[worker_id.hex()] = w
@@ -1717,6 +1732,7 @@ class Runtime:
         while not self._stopped:
             try:
                 conn = listener.accept()
+                protocol.enable_nodelay(conn)
             except (OSError, EOFError, multiprocessing.AuthenticationError):
                 if self._stopped:
                     return
@@ -2510,6 +2526,11 @@ class Runtime:
             # The node's agent sampled its own memory over threshold;
             # the victim policy runs here where the task table lives.
             self._oom_kill_one(msg[1], node=agent.node)
+        elif msg[0] == "worker_logs":
+            node_hex = (agent.node.node_id.hex()
+                        if agent.node is not None else "")
+            for wid, lines in msg[1]:
+                self._record_worker_lines(wid, lines, node=node_hex)
 
     def _on_agent_death(self, agent: AgentHandle):
         """Node agent connection dropped: the node is gone (reference: GCS
@@ -3383,6 +3404,35 @@ class Runtime:
             except Exception:
                 pass
 
+    # -------------------------------------------------------- log monitor --
+    def _record_worker_lines(self, worker_id_hex: str, lines, node=""):
+        # Ring mutation under the lock: state_query("worker_log")
+        # iterates these structures under the same lock.
+        with self.lock:
+            ring = self._worker_logs.setdefault(worker_id_hex,
+                                                deque(maxlen=1000))
+            ring.extend(lines)
+        if self.config.log_to_driver:
+            prefix = f"(worker={worker_id_hex[:8]}" + (
+                f" node={node[:8]})" if node else ")")
+            for ln in lines:
+                print(f"{prefix} {ln}", file=sys.stderr)
+
+    def _log_monitor_loop(self):
+        """Tail head-local worker log files into per-worker rings and the
+        driver's stderr (reference: log_monitor.py — file tailing with
+        (pid=, ip=) prefixes; remote nodes' agents ship their lines via
+        ("worker_logs", ...) instead)."""
+        from ray_tpu._private.logtail import tail_worker_logs
+
+        log_dir = os.path.join(self._sock_dir, "logs")
+        offsets: Dict[str, int] = {}
+        partial: Dict[str, bytes] = {}
+        while not self._stopped:
+            time.sleep(0.5)
+            for wid, lines in tail_worker_logs(log_dir, offsets, partial):
+                self._record_worker_lines(wid, lines)
+
     # ------------------------------------------------------------- reaper --
     def _reap_loop(self):
         while not self._stopped:
@@ -3746,6 +3796,17 @@ class Runtime:
                 n = len(self.task_spans)
                 return list(itertools.islice(self.task_spans,
                                              max(0, n - limit), None))
+        if kind == "worker_log":
+            # filters: worker_id (hex prefix ok), tail (line count).
+            prefix = filters.get("worker_id", "")
+            tail = int(filters.get("tail", 200))
+            with self.lock:
+                out = []
+                for wid, ring in self._worker_logs.items():
+                    if wid.startswith(prefix):
+                        out.append({"worker_id": wid,
+                                    "lines": list(ring)[-tail:]})
+            return out[:limit]
         if kind == "handler_stats":
             with self._handler_stats_lock:
                 return [{

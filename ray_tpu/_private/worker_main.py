@@ -149,9 +149,11 @@ class _WorkerRuntime:
     def dial(self, addr):
         from multiprocessing.connection import Client
 
-        return Client(tuple(addr),
+        conn = Client(tuple(addr),
                       authkey=bytes.fromhex(
                           os.environ.get("RAY_TPU_AUTHKEY", "")))
+        protocol.enable_nodelay(conn)
+        return conn
 
     def get_payload(self, func_id: str) -> Optional[bytes]:
         return self._fn_payloads.get(func_id)
@@ -871,6 +873,7 @@ def main():
     for attempt in range(20):
         try:
             conn = Client(address, authkey=authkey)
+            protocol.enable_nodelay(conn)
             break
         except AuthenticationError:
             # Transient: the accept loop can drop a challenge mid-

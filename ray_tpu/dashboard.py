@@ -66,15 +66,11 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> str:
         return web.json_response(chrome_trace(rt.state_query("spans")))
 
     async def index(request):
-        sections = ["cluster", "summary", "metrics", "jobs", "nodes",
-                    "actors", "tasks", "workers", "timeline",
-                    "handler_stats"]
-        links = "".join(
-            f'<li><a href="/api/{s}">/api/{s}</a></li>' for s in sections)
-        return web.Response(
-            text=f"<html><body><h2>ray_tpu dashboard</h2>"
-                 f"<ul>{links}</ul></body></html>",
-            content_type="text/html")
+        # Build-free SPA over the REST endpoints (reference:
+        # dashboard/client React app; see dashboard_static.py).
+        from ray_tpu.dashboard_static import INDEX_HTML
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
 
     app = web.Application()
     app.router.add_get("/", index)

@@ -1,0 +1,141 @@
+"""Dashboard frontend: a single self-contained HTML page over the REST
+API (reference: ``dashboard/client/`` — a React SPA; here a build-free
+vanilla-JS page polling the same endpoints, so the dashboard has a human
+UI without a node/webpack toolchain in the image)."""
+
+INDEX_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin: 0; background: #f6f7f9; color: #1a2029; }
+  header { background: #1a2029; color: #fff; padding: 10px 20px;
+           display: flex; align-items: baseline; gap: 16px; }
+  header h1 { font-size: 16px; margin: 0; }
+  header .sess { color: #9aa4b2; font-size: 12px; }
+  main { padding: 16px 20px; display: grid; gap: 16px;
+         grid-template-columns: repeat(auto-fit, minmax(420px, 1fr)); }
+  section { background: #fff; border-radius: 8px; padding: 12px 16px;
+            box-shadow: 0 1px 3px rgba(16,24,40,.1); }
+  h2 { font-size: 13px; text-transform: uppercase; letter-spacing: .06em;
+       color: #5b6575; margin: 0 0 8px; }
+  table { border-collapse: collapse; width: 100%; font-size: 12.5px; }
+  th, td { text-align: left; padding: 4px 8px;
+           border-bottom: 1px solid #eef0f3; white-space: nowrap; }
+  th { color: #5b6575; font-weight: 600; }
+  .num { text-align: right; font-variant-numeric: tabular-nums; }
+  .ok { color: #127a46; } .bad { color: #b3261e; }
+  .pill { display: inline-block; padding: 1px 8px; border-radius: 10px;
+          background: #eef0f3; font-size: 11.5px; }
+  .bar { height: 8px; background: #eef0f3; border-radius: 4px;
+         overflow: hidden; min-width: 120px; }
+  .bar > div { height: 100%; background: #3565d9; }
+  footer { color: #9aa4b2; font-size: 11px; padding: 8px 20px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="sess" id="session"></span>
+  <span class="sess" id="updated"></span>
+</header>
+<main>
+  <section><h2>Cluster</h2><div id="cluster"></div></section>
+  <section><h2>Nodes</h2><div id="nodes"></div></section>
+  <section><h2>Task summary</h2><div id="summary"></div></section>
+  <section><h2>Actors</h2><div id="actors"></div></section>
+  <section><h2>Jobs</h2><div id="jobs"></div></section>
+  <section><h2>Head handler latency</h2><div id="handlers"></div></section>
+</main>
+<footer>
+  raw JSON: <a href="/api/cluster">/api/cluster</a>,
+  <a href="/api/nodes">/api/nodes</a>, <a href="/api/tasks">/api/tasks</a>,
+  <a href="/api/actors">/api/actors</a>, <a href="/api/jobs">/api/jobs</a>,
+  <a href="/api/metrics">/api/metrics</a>,
+  <a href="/api/handler_stats">/api/handler_stats</a>,
+  <a href="/api/timeline">/api/timeline</a> (open in Perfetto)
+</footer>
+<script>
+const $ = id => document.getElementById(id);
+const esc = s => String(s).replace(/[&<>"]/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c]));
+function table(rows, cols) {
+  if (!rows.length) return '<span class="pill">none</span>';
+  let h = '<table><tr>' + cols.map(c =>
+      `<th${c.num ? ' class="num"' : ''}>${esc(c.name)}</th>`).join('')
+      + '</tr>';
+  for (const r of rows)
+    h += '<tr>' + cols.map(c => `<td class="${c.num ? 'num' : ''}">`
+        + c.fmt(r) + '</td>').join('') + '</tr>';
+  return h + '</table>';
+}
+function bar(frac) {
+  const pct = Math.round(Math.min(1, Math.max(0, frac)) * 100);
+  return `<div class="bar"><div style="width:${pct}%"></div></div>`;
+}
+async function j(path) { return (await fetch(path)).json(); }
+async function refresh() {
+  try {
+    const [cluster, nodes, summary, actors, jobs, handlers] =
+      await Promise.all([j('/api/cluster'), j('/api/nodes'),
+                         j('/api/summary'), j('/api/actors'),
+                         j('/api/jobs'), j('/api/handler_stats')]);
+    $('session').textContent = 'session ' + cluster.session_id;
+    $('updated').textContent = 'updated ' +
+        new Date().toLocaleTimeString();
+    const res = cluster.resources || {}, avail = cluster.available || {};
+    $('cluster').innerHTML = table(Object.keys(res).map(k => ({
+        k, total: res[k], avail: avail[k] ?? 0})), [
+      {name: 'resource', fmt: r => esc(r.k)},
+      {name: 'available', num: true,
+       fmt: r => esc(r.avail) + ' / ' + esc(r.total)},
+      {name: 'used', fmt: r =>
+          bar(r.total ? (r.total - r.avail) / r.total : 0)},
+    ]);
+    $('nodes').innerHTML = table(nodes, [
+      {name: 'node', fmt: r => esc(r.node_id.slice(0, 12))},
+      {name: 'state', fmt: r => r.alive
+          ? '<span class="ok">ALIVE</span>'
+          : '<span class="bad">DEAD</span>'},
+      {name: 'CPU', num: true, fmt: r =>
+          esc((r.available.CPU ?? 0) + ' / ' + (r.resources.CPU ?? 0))},
+      {name: 'TPU', num: true, fmt: r =>
+          esc((r.available.TPU ?? '-') + ' / ' + (r.resources.TPU ?? '-'))},
+    ]);
+    $('summary').innerHTML = table(
+      Object.entries(summary).sort().map(([k, v]) => ({k, v})), [
+        {name: 'task : state', fmt: r => esc(r.k)},
+        {name: 'count', num: true, fmt: r => esc(r.v)},
+      ]);
+    $('actors').innerHTML = table(actors.slice(0, 50), [
+      {name: 'actor', fmt: r => esc(r.actor_id.slice(0, 12))},
+      {name: 'name', fmt: r => esc(r.name || '-')},
+      {name: 'state', fmt: r => r.state === 'ALIVE'
+          ? '<span class="ok">ALIVE</span>'
+          : `<span class="pill">${esc(r.state)}</span>`},
+      {name: 'pending', num: true, fmt: r => esc(r.pending_tasks)},
+    ]);
+    $('jobs').innerHTML = table(jobs, [
+      {name: 'job', fmt: r => esc(r.job_id)},
+      {name: 'status', fmt: r => esc(r.status)},
+      {name: 'entrypoint', fmt: r => esc(
+          (r.entrypoint || '').slice(0, 48))},
+    ]);
+    $('handlers').innerHTML = table(handlers.slice(0, 12), [
+      {name: 'handler', fmt: r => esc(r.handler)},
+      {name: 'count', num: true, fmt: r => esc(r.count)},
+      {name: 'mean µs', num: true, fmt: r => esc(r.mean_us)},
+      {name: 'max ms', num: true, fmt: r => esc(r.max_ms)},
+    ]);
+  } catch (e) {
+    $('updated').textContent = 'update failed: ' + e;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -15,12 +15,12 @@ from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.air.result import Result
 from ray_tpu.tune.search import (
-    BasicVariantGenerator, Searcher, choice, grid_search, loguniform,
-    randint, sample_from, uniform,
+    BasicVariantGenerator, OptunaSearch, Searcher, TPESearcher, choice,
+    grid_search, loguniform, randint, sample_from, uniform,
 )
 from ray_tpu.tune.schedulers import (
-    AsyncHyperBandScheduler, FIFOScheduler, PopulationBasedTraining,
-    TrialScheduler,
+    AsyncHyperBandScheduler, FIFOScheduler, HyperBandScheduler,
+    MedianStoppingRule, PopulationBasedTraining, TrialScheduler,
 )
 from ray_tpu.tune.trainable import Trainable, wrap_function
 from ray_tpu.tune.trial_runner import Trial, TrialRunner
@@ -130,7 +130,7 @@ class Tuner:
 
 
 def run(trainable, *, config: Optional[Dict[str, Any]] = None,
-        num_samples: int = 1, scheduler=None, stop=None,
+        num_samples: int = 1, scheduler=None, search_alg=None, stop=None,
         metric: Optional[str] = None, mode: str = "max",
         max_concurrent_trials: int = 8,
         resources_per_trial: Optional[Dict[str, float]] = None,
@@ -141,6 +141,7 @@ def run(trainable, *, config: Optional[Dict[str, Any]] = None,
         trainable, param_space=config,
         tune_config=TuneConfig(metric=metric, mode=mode,
                                num_samples=num_samples, scheduler=scheduler,
+                               search_alg=search_alg,
                                max_concurrent_trials=max_concurrent_trials,
                                seed=seed),
         run_config=RunConfig(stop=stop, storage_path=storage_path),
@@ -151,6 +152,8 @@ def run(trainable, *, config: Optional[Dict[str, Any]] = None,
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "run", "Trainable", "Trial",
     "TrialRunner", "choice", "uniform", "loguniform", "randint",
-    "grid_search", "sample_from", "BasicVariantGenerator", "Searcher", "TrialScheduler",
-    "FIFOScheduler", "AsyncHyperBandScheduler", "PopulationBasedTraining",
+    "grid_search", "sample_from", "BasicVariantGenerator", "Searcher",
+    "TPESearcher", "OptunaSearch", "TrialScheduler", "FIFOScheduler",
+    "AsyncHyperBandScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
 ]

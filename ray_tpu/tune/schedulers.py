@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+PAUSE = "PAUSE"
 
 
 class TrialScheduler:
@@ -22,6 +23,14 @@ class TrialScheduler:
 
     def on_trial_complete(self, runner, trial, result: Dict[str, Any]):
         pass
+
+    def on_trial_add(self, runner, trial):
+        """Called when the runner starts a new trial (reference:
+        TrialScheduler.on_trial_add)."""
+
+    def on_step(self, runner):
+        """Called once per runner loop turn — synchronous schedulers
+        promote paused trials here (reference: choose_trial_to_run)."""
 
 
 class FIFOScheduler(TrialScheduler):
@@ -70,6 +79,150 @@ class AsyncHyperBandScheduler(TrialScheduler):
                 cutoff = sorted(rung, reverse=True)[cutoff_idx]
                 if v < cutoff:
                     return STOP
+        return CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: schedulers/hyperband.py).
+
+    Trials fill a bracket as they arrive; every bracket member PAUSES at
+    the bracket's current milestone, and once the whole bracket is
+    parked the top 1/eta are promoted (unpaused with an eta-times larger
+    budget) while the rest stop — classic successive halving, but
+    SYNCHRONOUS: promotion decisions see the complete rung, unlike
+    ASHA's running cutoffs."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 81, reduction_factor: float = 3.0,
+                 bracket_size: int = 9, grace_period: int = 1):
+        self._metric = metric
+        self._mode = mode
+        self._max_t = max_t
+        self._eta = reduction_factor
+        self._bracket_size = bracket_size
+        self._grace = grace_period
+        # bracket: {"trials": {tid: score}, "milestone": int,
+        #           "paused": set, "done": set}
+        self._brackets: List[Dict[str, Any]] = []
+        self._trial_bracket: Dict[str, int] = {}
+
+    def _val(self, result):
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def _bracket_of(self, trial) -> Dict[str, Any]:
+        bi = self._trial_bracket.get(trial.trial_id)
+        if bi is None:
+            if (not self._brackets
+                    or len(self._brackets[-1]["trials"])
+                    >= self._bracket_size):
+                self._brackets.append({
+                    "trials": {}, "milestone": self._grace,
+                    "paused": set(), "done": set()})
+            bi = len(self._brackets) - 1
+            self._trial_bracket[trial.trial_id] = bi
+            self._brackets[bi]["trials"][trial.trial_id] = None
+        return self._brackets[bi]
+
+    def on_trial_add(self, runner, trial):
+        # Membership binds at START: a promotion decision must see the
+        # whole bracket, not just the trials that happened to report
+        # first (a fast trial would otherwise get promoted alone).
+        self._bracket_of(trial)
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        br = self._bracket_of(trial)
+        v = self._val(result)
+        if v is not None:
+            br["trials"][trial.trial_id] = v
+        t = result.get("training_iteration", 0)
+        if t >= self._max_t:
+            br["done"].add(trial.trial_id)
+            return STOP
+        if t >= br["milestone"]:
+            br["paused"].add(trial.trial_id)
+            return PAUSE
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial, result):
+        bi = self._trial_bracket.get(trial.trial_id)
+        if bi is not None:
+            self._brackets[bi]["done"].add(trial.trial_id)
+
+    def on_step(self, runner):
+        for br in self._brackets:
+            live = set(br["trials"]) - br["done"]
+            if not live or not live <= br["paused"]:
+                continue  # someone still running (or bracket finished)
+            # Whole rung parked: promote the top 1/eta.
+            ranked = sorted(
+                live,
+                key=lambda tid: (br["trials"][tid]
+                                 if br["trials"][tid] is not None
+                                 else float("-inf")),
+                reverse=True)
+            keep = ranked[:max(1, math.ceil(len(ranked) / self._eta))]
+            br["milestone"] = min(self._max_t,
+                                  int(br["milestone"] * self._eta))
+            for tid in ranked:
+                trial = runner.get_trial(tid)
+                if trial is None:
+                    br["done"].add(tid)
+                    continue
+                if tid in keep:
+                    br["paused"].discard(tid)
+                    runner.unpause_trial(trial)
+                else:
+                    br["done"].add(tid)
+                    br["paused"].discard(tid)
+                    runner.stop_trial(trial)
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of other
+    trials' running averages at the same step (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 grace_period: int = 4, min_samples_required: int = 3):
+        self._metric = metric
+        self._mode = mode
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        # trial_id -> list of values (one per reported iteration)
+        self._histories: Dict[str, List[float]] = {}
+
+    def _val(self, result):
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        v = self._val(result)
+        if v is None:
+            return CONTINUE
+        hist = self._histories.setdefault(trial.trial_id, [])
+        hist.append(v)
+        t = result.get("training_iteration", len(hist))
+        if t < self._grace:
+            return CONTINUE
+        # Other trials may trail this one (async execution): compare
+        # against their running means over whatever they have reported,
+        # floored at the grace period so one fast trial can still be
+        # judged (reference computes the mean at step t; requiring
+        # len(h) >= t would exempt the fastest trial forever).
+        others = [h for tid, h in self._histories.items()
+                  if tid != trial.trial_id and len(h) >= self._grace]
+        if len(others) < self._min_samples:
+            return CONTINUE
+        running_means = sorted(
+            sum(h[:t]) / min(t, len(h)) for h in others)
+        median = running_means[len(running_means) // 2]
+        if max(hist) < median:
+            return STOP
         return CONTINUE
 
 

@@ -14,12 +14,11 @@ import json
 import os
 import pickle
 import time
-import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu as ray
 from ray_tpu.tune import schedulers as sched_mod
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
 
 PENDING, RUNNING, PAUSED, TERMINATED, ERRORED = (
@@ -65,6 +64,7 @@ class TrialRunner:
         self._ckpt_every = checkpoint_every
         self.trials: List[Trial] = []
         self._future_to_trial: Dict[Any, Trial] = {}
+        self._restore_futures: Dict[str, Any] = {}
         self._exhausted = False
         self._iterations = 0
 
@@ -81,6 +81,23 @@ class TrialRunner:
         a mutated config at its next boundary."""
         target.pending_restore = (donor.latest_checkpoint, new_config)
 
+    def unpause_trial(self, trial: Trial):
+        """Resume a PAUSED trial from its checkpoint (synchronous
+        HyperBand promotion; reference: trial PAUSED -> RUNNING via
+        choose_trial_to_run)."""
+        if trial.status != PAUSED:
+            return
+        self._start_trial(trial)
+
+    def stop_trial(self, trial: Trial):
+        """Scheduler-initiated stop of a trial that is not currently
+        reporting (e.g. a paused rung loser)."""
+        if trial.status in (TERMINATED, ERRORED):
+            return
+        self._searcher.on_trial_complete(trial.trial_id,
+                                         trial.last_result)
+        self._terminate(trial, TERMINATED)
+
     def _make_actor(self, trial: Trial):
         res = dict(self._resources)
         cpu = res.pop("CPU", 1.0)
@@ -94,7 +111,15 @@ class TrialRunner:
         trial.actor = self._make_actor(trial)
         trial.status = RUNNING
         if trial.latest_checkpoint is not None:
-            ray.get(trial.actor.restore.remote(trial.latest_checkpoint))
+            # Async submit: per-actor FIFO guarantees restore runs
+            # before train.  A blocking get here would wedge the whole
+            # runner loop whenever the new actor waits for a CPU that a
+            # still-running trial holds (the trial that would free it is
+            # serviced by THIS loop).  The future is kept so a failed
+            # restore surfaces as a trial error instead of silently
+            # training from scratch.
+            self._restore_futures[trial.trial_id] = \
+                trial.actor.restore.remote(trial.latest_checkpoint)
         self._future_to_trial[trial.actor.train.remote()] = trial
 
     def _maybe_add_trials(self):
@@ -102,12 +127,17 @@ class TrialRunner:
                and sum(1 for t in self.trials
                        if t.status in (PENDING, RUNNING))
                < self._num_concurrent):
-            cfg = self._searcher.suggest(uuid.uuid4().hex[:8])
+            # suggest() is keyed by the SAME id later passed to
+            # on_trial_complete — model-based searchers match the two to
+            # attach the observation to the suggested config.
+            trial_id = f"trial_{len(self.trials):04d}"
+            cfg = self._searcher.suggest(trial_id)
             if cfg is None:
                 self._exhausted = True
                 break
-            trial = Trial(f"trial_{len(self.trials):04d}", cfg)
+            trial = Trial(trial_id, cfg)
             self.trials.append(trial)
+            self._scheduler.on_trial_add(self, trial)
             self._start_trial(trial)
 
     def _should_stop_trial(self, result: Dict[str, Any]) -> bool:
@@ -142,6 +172,10 @@ class TrialRunner:
     def step(self):
         """One event-loop turn (reference: trial_runner.py:1315)."""
         self._maybe_add_trials()
+        # Synchronous schedulers promote paused rungs here; must run
+        # even with no futures in flight (a fully parked bracket would
+        # otherwise spin forever).
+        self._scheduler.on_step(self)
         if not self._future_to_trial:
             return
         done, _ = ray.wait(list(self._future_to_trial),
@@ -160,6 +194,16 @@ class TrialRunner:
             self.save_experiment()
 
     def _on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        rf = self._restore_futures.pop(trial.trial_id, None)
+        if rf is not None:
+            # The result arrived AFTER the restore (per-actor FIFO), so
+            # this future is done; surface a failed checkpoint load as a
+            # trial error — the result came from an UNRESTORED model.
+            try:
+                ray.get(rf, timeout=5.0)
+            except Exception as e:
+                self._on_trial_error(trial, e)
+                return
         trial.last_result = result
         trial.results.append(result)
         # Checkpoint after every boundary so ASHA-stops and PBT-exploits
@@ -173,6 +217,12 @@ class TrialRunner:
             self._scheduler.on_trial_complete(self, trial, result)
             self._searcher.on_trial_complete(trial.trial_id, result)
             self._terminate(trial, TERMINATED)
+            return
+        if decision == PAUSE:
+            # Checkpoint already saved above; release the actor — the
+            # scheduler promotes (unpause_trial) or stops the trial on a
+            # later on_step.
+            self._terminate(trial, PAUSED)
             return
         if trial.pending_restore is not None:
             blob, new_config = trial.pending_restore
@@ -199,6 +249,9 @@ class TrialRunner:
             self._start_trial(trial)  # restores latest_checkpoint
             return
         trial.error = str(err)
+        # Synchronous schedulers must learn the trial is gone, or a
+        # bracket would wait forever for its rung report.
+        self._scheduler.on_trial_complete(self, trial, trial.last_result)
         self._terminate(trial, ERRORED)
 
     def is_finished(self) -> bool:

@@ -50,6 +50,14 @@ def list_placement_groups(**kw) -> List[Dict[str, Any]]:
     return _query("placement_groups", **kw)
 
 
+def get_worker_log(worker_id: str = "", tail: int = 200
+                   ) -> List[Dict[str, Any]]:
+    """Captured stdout/stderr lines of workers (reference: the log
+    files under the session dir + `ray logs`); ``worker_id`` may be a
+    hex prefix."""
+    return _query("worker_log", worker_id=worker_id, tail=tail)
+
+
 def summarize_tasks() -> Dict[str, int]:
     """Task-name x state counts (reference: summarize_tasks, api.py:1278)."""
     counts: _Counter = _Counter()

@@ -197,5 +197,11 @@ def test_dashboard_endpoints(ray4):
         assert isinstance(get("/api/summary"), dict)
         assert isinstance(get("/api/metrics"), dict)
         assert get("/api/jobs") == []
+        assert isinstance(get("/api/handler_stats"), list)
+        assert isinstance(get("/api/timeline"), list)
+        with urllib.request.urlopen(url + "/", timeout=10) as r:
+            html = r.read().decode()
+        assert "<title>ray_tpu dashboard</title>" in html
+        assert "/api/handler_stats" in html  # SPA wired to the REST API
     finally:
         stop_dashboard()

@@ -1,0 +1,66 @@
+"""Worker log capture + streaming (reference: log_monitor.py tailing
+worker files to the driver; `ray logs` surface)."""
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.util.state import get_worker_log
+
+
+@pytest.fixture
+def init2():
+    ray.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray.shutdown()
+
+
+def _wait_lines(needle, timeout=15):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for entry in get_worker_log():
+            if any(needle in ln for ln in entry["lines"]):
+                return entry
+        time.sleep(0.4)
+    return None
+
+
+def test_task_prints_are_captured(init2, capfd):
+    @ray.remote
+    def noisy():
+        print("hello-from-worker-42")
+        return 1
+
+    assert ray.get(noisy.remote()) == 1
+    entry = _wait_lines("hello-from-worker-42")
+    assert entry is not None, get_worker_log()
+    assert entry["worker_id"]
+    # log_to_driver re-prints with a worker prefix on driver stderr.
+    err = capfd.readouterr().err
+    assert "hello-from-worker-42" in err
+    assert "(worker=" in err
+
+
+def test_remote_node_logs_ship_to_head():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = Cluster(head_num_cpus=1)
+    try:
+        node_id = cluster.add_node(num_cpus=2, external=True)
+
+        @ray.remote
+        def remote_noisy():
+            print("hello-from-remote-node")
+            return 2
+
+        ref = remote_noisy.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id, soft=False)).remote()
+        assert ray.get(ref, timeout=60) == 2
+        entry = _wait_lines("hello-from-remote-node")
+        assert entry is not None
+    finally:
+        cluster.shutdown()
