@@ -96,6 +96,24 @@ def _format_batch(rows: List[Any], batch_format: str):
     return rows
 
 
+def _native_batch(block, batch_format: str):
+    """The block itself when it already IS a valid batch of
+    ``batch_format`` (the zero-copy pass-through both map_batches and
+    iter_batches use), else None.  Numpy tensor batches are marked
+    read-only before crossing to the consumer: they may be views over
+    the shared store (or the driver's value cache), so an in-place
+    mutation would silently corrupt every later read — the reference
+    marks plasma-backed arrays the same way."""
+    if batch_format == "pyarrow" and _is_arrow(block):
+        return block
+    if batch_format == "numpy" and isinstance(block, dict) and all(
+            isinstance(v, np.ndarray) for v in block.values()):
+        for v in block.values():
+            v.setflags(write=False)
+        return block
+    return None
+
+
 def _apply_op(op, block):
     """One fused-plan step applied to a whole block (runs inside a task)."""
     kind, arg = op[0], op[1]
@@ -110,8 +128,10 @@ def _apply_op(op, block):
         return out
     if kind == "map_batches":
         batch_format = op[2]
-        # Fast paths keep the native block kind (no row materialization);
-        # everything else goes rows -> _format_batch.
+        # Fast paths keep the native block kind (no row materialization).
+        # Deliberately NOT _native_batch: UDF inputs stay writable —
+        # in-task mutation of an inline batch is harmless (the task owns
+        # it), while consumer-facing iter_batches marks them read-only.
         if batch_format == "pyarrow" and _is_arrow(block):
             batch = block
         elif batch_format == "numpy" and isinstance(block, dict):
@@ -612,15 +632,34 @@ class Dataset:
         for ref in self._stream_refs():
             yield from _block_rows(ray.get(ref))
 
-    def iter_batches(self, *, batch_size: int = 256,
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Any]:
+                     drop_last: bool = False,
+                     prefetch_blocks: int = 2) -> Iterator[Any]:
+        """Batch iterator (reference: dataset.py iter_batches).
+
+        ``prefetch_blocks`` widens the streaming window so upcoming
+        blocks execute while the consumer works (the reference's
+        prefetch_batches).  ``batch_size=None`` yields each BLOCK as one
+        native batch — dict-of-numpy and arrow blocks pass through
+        zero-copy (views over the store mapping, never row-materialized),
+        which is the train-ingest fast path."""
+        window = max(DEFAULT_STREAMING_WINDOW, prefetch_blocks)
+        if batch_size is None:
+            for ref in self._stream_refs(window=window):
+                block = ray.get(ref)
+                native = _native_batch(block, batch_format)
+                yield (native if native is not None
+                       else _format_batch(list(_block_rows(block)),
+                                          batch_format))
+            return
         buf: List[Any] = []
-        for row in self.iter_rows():
-            buf.append(row)
-            if len(buf) == batch_size:
-                yield _format_batch(buf, batch_format)
-                buf = []
+        for ref in self._stream_refs(window=window):
+            for row in _block_rows(ray.get(ref)):
+                buf.append(row)
+                if len(buf) == batch_size:
+                    yield _format_batch(buf, batch_format)
+                    buf = []
         if buf and not drop_last:
             yield _format_batch(buf, batch_format)
 

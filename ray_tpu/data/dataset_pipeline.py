@@ -62,11 +62,13 @@ class DatasetPipeline:
         for w in self._windows:
             yield from w.iter_rows()
 
-    def iter_batches(self, *, batch_size: int = 256,
-                     batch_format: str = "numpy") -> Iterator[Any]:
+    def iter_batches(self, *, batch_size: "int | None" = 256,
+                     batch_format: str = "numpy",
+                     prefetch_blocks: int = 2) -> Iterator[Any]:
         for w in self._windows:
             yield from w.iter_batches(batch_size=batch_size,
-                                      batch_format=batch_format)
+                                      batch_format=batch_format,
+                                      prefetch_blocks=prefetch_blocks)
 
     def split(self, n: int) -> List["DatasetPipeline"]:
         """Shard each window for n consumers (reference:

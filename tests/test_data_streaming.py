@@ -84,6 +84,26 @@ def test_mixed_task_and_actor_stages(cluster):
     assert "map_batches(actors)" in s, s
 
 
+def test_whole_block_batches_are_zero_copy(cluster):
+    """iter_batches(batch_size=None) yields native blocks; tensor blocks
+    come back as views over the store mapping (no row materialization)."""
+    block = {"a": np.arange(4096, dtype=np.float32)}
+    ds = data.from_items(list(range(8)), parallelism=4).map_batches(
+        lambda b: dict(block))
+    batches = list(ds.iter_batches(batch_size=None))
+    assert len(batches) == 4
+    for b in batches:
+        assert isinstance(b, dict)
+        np.testing.assert_array_equal(b["a"], block["a"])
+        # Zero-copy: the array is a VIEW over the shm mapping — and
+        # read-only, so a consumer's in-place mutation cannot corrupt
+        # the stored block for later epochs.
+        assert not b["a"].flags["OWNDATA"]
+        assert not b["a"].flags["WRITEABLE"]
+        with pytest.raises(ValueError):
+            b["a"][0] = 1.0
+
+
 def test_windowed_pipeline_bounds_store_usage(cluster):
     """A windowed pipeline over data >> the bound must keep peak store
     usage under a fraction of the total data size (the backpressure
