@@ -16,6 +16,16 @@ Public surface mirrors ``python/ray/__init__.py``:
     ray.get(f.remote(1))
 """
 
+import os as _os
+
+# Opt-in lock-order checker (RAY_TPU_LOCKCHECK=1 logs cycles, =raise
+# raises).  Must install BEFORE the runtime modules below are imported so
+# their module- and instance-level locks are minted as recording proxies.
+if _os.environ.get("RAY_TPU_LOCKCHECK", "0") not in ("", "0"):
+    from ray_tpu.devtools import lockcheck as _lockcheck
+
+    _lockcheck.install_from_env()
+
 from ray_tpu._private.api import (
     available_resources,
     cancel,

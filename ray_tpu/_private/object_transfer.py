@@ -78,7 +78,19 @@ def serve_connection(conn, store):
 class ObjectPuller:
     """Consumer-side client: cached connections to home-store object
     servers, pulling segments as chunk streams (reference:
-    ObjectManager::Pull + ObjectBufferPool chunk assembly)."""
+    ObjectManager::Pull + ObjectBufferPool chunk assembly).
+
+    LOCK ORDER (checked by tests/test_lockcheck.py via devtools.lockcheck):
+    the registry ``_lock`` and the per-connection locks are INDEPENDENT
+    LEAVES — neither may be acquired while the other is held.  The
+    registry lock guards only the ``_conns`` dict (lookup/insert/pop,
+    never I/O under it); a per-connection lock is held across an entire
+    fetch stream (seconds of I/O), so taking ``_lock`` inside it would
+    stall every other connection's lookup, and taking a connection lock
+    inside ``_lock`` inverts that order.  Note ``fetch``'s error path:
+    ``drop`` (registry lock) runs only AFTER the ``with lock`` block has
+    released the connection lock.
+    """
 
     def __init__(self, authkey: bytes):
         self._authkey = authkey

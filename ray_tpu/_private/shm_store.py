@@ -36,6 +36,15 @@ _HEADER = struct.Struct("<8sQ")  # magic, meta_len
 # Large-buffer writes fan out across threads: numpy's copy releases the
 # GIL, so a single put saturates memory bandwidth instead of one core's
 # memcpy (the plasma store's parallel memcopy, store.cc memcopy_threads).
+#
+# LOCK ORDER (checked by tests/test_lockcheck.py via devtools.lockcheck):
+# the module-level ``_copy_pool_lock`` and every store's ``_lock`` are
+# INDEPENDENT LEAVES — no code path may hold one while acquiring the
+# other.  Concretely: ``create_from_parts`` runs its copies (which may
+# take ``_copy_pool_lock`` to build the pool) BEFORE taking ``_lock`` for
+# accounting, and nothing under ``_lock`` ever copies buffer bytes.
+# Breaking this would serialize every store's 8 GB/s parallel memcpy
+# behind one global mutex — or deadlock against a second store.
 _PARALLEL_COPY_MIN = 16 << 20
 _COPY_THREADS = min(8, max(1, (os.cpu_count() or 1)))
 _copy_pool = None

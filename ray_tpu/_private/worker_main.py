@@ -839,6 +839,18 @@ def _get_async_loop():
     with _async_loop_lock:
         if _async_loop is None:
             loop = asyncio.new_event_loop()
+            import sys as _sys
+
+            lockcheck = _sys.modules.get("ray_tpu.devtools.lockcheck")
+            if lockcheck is not None and lockcheck.enabled():
+                # Record async actor handlers that block this loop >50ms
+                # (a blocking get/sleep in an async method stalls EVERY
+                # coroutine sharing the loop; lint rule RTL101 catches the
+                # static cases, this catches the dynamic ones).  Checking
+                # sys.modules instead of the env flag honors programmatic
+                # lockcheck.install() too, and never imports devtools on
+                # the normal path.
+                lockcheck.watch_loop(loop)
             t = threading.Thread(target=loop.run_forever, daemon=True,
                                  name="ray_tpu-async")
             t.start()

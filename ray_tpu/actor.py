@@ -204,7 +204,14 @@ class ActorClass:
             except ValueError:
                 pass
         if self._payload is None:
-            self._payload = serialization.dumps_inline(self._cls)
+            try:
+                self._payload = serialization.dumps_inline(self._cls)
+            except Exception as err:  # noqa: BLE001 — diagnosed, re-raised
+                from ray_tpu.devtools.serializability import (
+                    diagnose_pickle_error,
+                )
+
+                diagnose_pickle_error(self._cls, self.__name__, err)
             self._func_id = "actor-" + hashlib.sha1(
                 self._payload).hexdigest()[:24]
         method_meta = _collect_methods(self._cls)

@@ -70,13 +70,21 @@ def serialize_args(rt, args, kwargs, spec):
     ``src/ray/core_worker/core_worker.cc`` SubmitTask arg handling)."""
     tmp_segments = []
 
-    def one(a):
+    def one(a, where):
         if isinstance(a, ObjectRef):
             return ("ref", a.id().binary())
         from ray_tpu._private.ids import ObjectID
 
         oid = ObjectID.for_put()
-        descr = rt.serialize_value(a, oid)
+        try:
+            descr = rt.serialize_value(a, oid)
+        except Exception as err:  # noqa: BLE001 — diagnosed and re-raised
+            # A raw "cannot pickle _thread.lock" from three frames deep is
+            # useless for a 40-field config; walk the argument and name
+            # the exact leaf (e.g. arg[0].fn.__closure__['model']).
+            from ray_tpu.devtools.serializability import diagnose_pickle_error
+
+            diagnose_pickle_error(a, where, err)
         if descr[0] in ("shm", "spilled"):
             # Ephemeral arg storage (segment name, or spill-file path when
             # the store was full) — freed when the task / its lineage ends.
@@ -88,8 +96,30 @@ def serialize_args(rt, args, kwargs, spec):
     # protocol; reference: reference_count.cc borrowed refs).
     rt.begin_ref_collection()
     try:
-        spec["args"] = [one(a) for a in args]
-        spec["kwargs"] = {k: one(v) for k, v in (kwargs or {}).items()}
+        try:
+            spec["args"] = [one(a, f"arg[{i}]") for i, a in enumerate(args)]
+            spec["kwargs"] = {k: one(v, f"kwargs[{k!r}]")
+                              for k, v in (kwargs or {}).items()}
+        except BaseException:
+            # The spec is never submitted, so the runtime's task-end path
+            # will never free segments already written for EARLIER args;
+            # a retried failing call would otherwise leak one per attempt.
+            import os as _os
+
+            shm = getattr(rt, "shm", None)
+            for name, size in tmp_segments:
+                try:
+                    if _os.path.isabs(name):
+                        # Spill file (store-full fallback): plain unlink —
+                        # routing it through ShmStore.unlink would debit
+                        # shm accounting for bytes never charged to it
+                        # (mirrors runtime._release_spec_resources).
+                        _os.unlink(name)
+                    elif shm is not None:
+                        shm.unlink(name, size)
+                except Exception:
+                    pass
+            raise
     finally:
         spec["nested_refs"] = rt.end_ref_collection()
     spec["tmp_segments"] = tmp_segments
@@ -123,7 +153,14 @@ class RemoteFunction:
 
     def _ensure_registered(self, rt):
         if self._payload is None:
-            self._payload = serialization.dumps_inline(self._fn)
+            try:
+                self._payload = serialization.dumps_inline(self._fn)
+            except Exception as err:  # noqa: BLE001 — diagnosed, re-raised
+                from ray_tpu.devtools.serializability import (
+                    diagnose_pickle_error,
+                )
+
+                diagnose_pickle_error(self._fn, self.__name__, err)
         if rt.is_worker():
             import hashlib
 

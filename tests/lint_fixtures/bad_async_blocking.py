@@ -1,0 +1,25 @@
+"""RTL101/RTL102 bad cases: blocking calls on the event loop."""
+import time
+
+import ray_tpu
+
+
+async def blocking_get_in_handler(ref):
+    return ray_tpu.get(ref)  # EXPECT: RTL101
+
+
+async def blocking_wait_in_handler(refs):
+    ready, rest = ray_tpu.wait(refs)  # EXPECT: RTL101
+    return ready, rest
+
+
+async def blocking_ref_get(object_ref):
+    return object_ref.get()  # EXPECT: RTL101
+
+
+async def blocking_get_objects(rt, refs):
+    return rt.get_objects(refs)  # EXPECT: RTL101
+
+
+async def sleepy_handler():
+    time.sleep(0.5)  # EXPECT: RTL102

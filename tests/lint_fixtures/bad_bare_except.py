@@ -1,0 +1,16 @@
+"""RTL301 bad cases: bare except swallowing SystemExit."""
+
+
+def worker_loop(queue):
+    while True:
+        try:
+            queue.get()
+        except:  # EXPECT: RTL301
+            pass
+
+
+def agent_loop(conn):
+    try:
+        return conn.recv()
+    except:  # EXPECT: RTL301
+        return None

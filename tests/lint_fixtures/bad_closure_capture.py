@@ -1,0 +1,34 @@
+"""RTL201 bad cases: @remote functions closure-capturing refs/arrays."""
+import numpy as np
+
+import ray_tpu
+
+
+def build_pipeline(f):
+    ref = f.remote(1)
+
+    @ray_tpu.remote
+    def uses_captured_ref():  # EXPECT: RTL201
+        return ref
+
+    return uses_captured_ref
+
+
+def build_training_step():
+    weights = np.zeros((4096, 4096))
+
+    @ray_tpu.remote(num_cpus=1)
+    def train_step(batch):  # EXPECT: RTL201
+        return batch @ weights
+
+    return train_step
+
+
+def capture_from_put():
+    dataset = ray_tpu.put([1, 2, 3])
+
+    @ray_tpu.remote
+    def consume():  # EXPECT: RTL201
+        return dataset
+
+    return consume

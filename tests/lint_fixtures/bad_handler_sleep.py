@@ -1,0 +1,22 @@
+"""RTL103 bad cases: sleeping on a shared dispatch thread."""
+import time as _time
+
+
+def handle_message(msg):
+    _time.sleep(0.1)  # EXPECT: RTL103
+
+
+def _handle_reply(conn, msg):
+    _time.sleep(1)  # EXPECT: RTL103
+
+
+def on_peer_msg(payload):
+    _time.sleep(0.05)  # EXPECT: RTL103
+
+
+def poll_handler(queue):
+    _time.sleep(0.25)  # EXPECT: RTL103
+
+
+def serve_connection(conn, store):
+    _time.sleep(0.1)  # EXPECT: RTL103

@@ -1,0 +1,22 @@
+"""RTL401 bad cases: lock acquisition outside `with`."""
+import threading
+
+_registry_lock = threading.Lock()
+
+
+def leaky_acquire(table, key, value):
+    _registry_lock.acquire()  # EXPECT: RTL401
+    table[key] = value  # an exception here leaks the lock
+    _registry_lock.release()
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def leaky_method(self):
+        self._lock.acquire()  # EXPECT: RTL401
+        try:
+            return 1
+        finally:
+            self._lock.release()

@@ -1,0 +1,40 @@
+"""RTL101/RTL102 good cases: nothing here may fire."""
+import asyncio
+import time
+
+import ray_tpu
+
+
+async def awaits_the_ref(ref):
+    return await ref
+
+
+async def pushes_into_executor(ref):
+    loop = asyncio.get_event_loop()
+    # The blocking get lives in a nested SYNC lambda handed to a worker
+    # thread — the event loop never blocks; must not fire.
+    return await loop.run_in_executor(None, lambda: ray_tpu.get(ref))
+
+
+async def async_sleep_is_fine():
+    await asyncio.sleep(0.5)
+
+
+def sync_get_is_fine(ref):
+    # Blocking get in a plain function: the caller owns the thread.
+    return ray_tpu.get(ref)
+
+
+async def dict_get_is_not_a_ref(mapping):
+    # .get() on a non-ref-ish receiver must not fire.
+    return mapping.get("key")
+
+
+async def ref_map_lookup_is_not_a_blocking_get(self, oid):
+    # A POSITIONAL arg means container lookup, not ObjectRef.get() —
+    # even on a ref-ish receiver name this must not fire.
+    return self._object_refs.get(oid)
+
+
+def sync_sleep_in_plain_function():
+    time.sleep(0.01)

@@ -1,0 +1,16 @@
+"""RTL301 good cases: nothing here may fire."""
+
+
+def catches_exception_only(queue):
+    try:
+        return queue.get()
+    except Exception:
+        return None
+
+
+def bare_except_that_reraises(conn):
+    try:
+        return conn.recv()
+    except:
+        conn.close()
+        raise  # re-raise keeps SystemExit/KeyboardInterrupt propagating

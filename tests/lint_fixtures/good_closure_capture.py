@@ -1,0 +1,44 @@
+"""RTL201 good cases: nothing here may fire."""
+import numpy as np
+
+import ray_tpu
+
+
+def pass_as_argument(f):
+    ref = f.remote(1)
+
+    @ray_tpu.remote
+    def takes_argument(x):
+        return x
+
+    return takes_argument.remote(ref)
+
+
+def benign_closure_capture():
+    # Capturing a plain config value is normal closure behavior.
+    learning_rate = 0.1
+
+    @ray_tpu.remote
+    def step(x):
+        return x * learning_rate
+
+    return step
+
+
+def module_level_np_is_fine():
+    @ray_tpu.remote
+    def make_locally(n):
+        # Array built INSIDE the task — nothing shipped per call.
+        return np.zeros((n, n))
+
+    return make_locally
+
+
+def suppressed_deliberate_capture(f):
+    small_ref = f.remote(1)
+
+    @ray_tpu.remote
+    def reuses_ref():  # noqa: RTL201 -- tiny ref, resubmitted in a loop
+        return small_ref
+
+    return reuses_ref

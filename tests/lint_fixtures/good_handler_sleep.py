@@ -1,0 +1,21 @@
+"""RTL103 good cases: sleeps on dedicated/background threads are fine."""
+import time
+
+
+def retry_dial_loop(address):
+    for attempt in range(20):
+        time.sleep(0.05 * (attempt + 1))
+
+
+def _memory_monitor_thread():
+    while True:
+        time.sleep(0.5)
+
+
+def decref_flusher():
+    time.sleep(0.25)
+
+
+def handle_message(msg):
+    # A handler that does NOT sleep must not fire.
+    return msg[0]

@@ -1,0 +1,34 @@
+"""RTL401 good cases: nothing here may fire."""
+import threading
+
+_registry_lock = threading.Lock()
+
+
+def with_statement(table, key, value):
+    with _registry_lock:
+        table[key] = value
+
+
+def try_lock_is_exempt():
+    # `with` cannot express a non-blocking or timed acquire.
+    if _registry_lock.acquire(False):
+        _registry_lock.release()
+    if _registry_lock.acquire(blocking=False):
+        _registry_lock.release()
+    if _registry_lock.acquire(timeout=0.1):
+        _registry_lock.release()
+    if _registry_lock.acquire(True, 0.1):  # positional timeout form
+        _registry_lock.release()
+
+
+def suppressed_handoff():
+    # Cross-function lock handoff (acquired here, released by a callback)
+    # cannot use `with`; suppressed with rationale.
+    _registry_lock.acquire()  # noqa: RTL401 -- handed off to callback
+    return _registry_lock
+
+
+def resource_accounting_is_not_a_lock(node, req):
+    # .acquire() on non-lock-ish receivers (resource accounting) is fine.
+    node.acquire(req)
+    node.release(req)

@@ -1,0 +1,184 @@
+"""check_serializability / find_unserializable tests, plus the @remote
+error-path wiring: a pickling failure at submit must name the exact
+non-serializable leaf with its path."""
+
+import threading
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.devtools.serializability import (
+    SerializationTrapError,
+    check_serializability,
+    find_unserializable,
+)
+from ray_tpu.util import check_serializability as util_export
+
+
+def test_exported_via_ray_tpu_util():
+    # Reference parity surface: ray.util.check_serializability.
+    assert util_export is check_serializability
+
+
+def test_clean_objects_pass():
+    assert check_serializability({"a": [1, "x", (2.0, None)]}) is None
+    assert find_unserializable([1, 2, 3]) is None
+
+
+def test_closure_capture_path():
+    model = threading.Lock()  # classic unpicklable leaf
+
+    def train(x):
+        return model, x
+
+    path, leaf, err = find_unserializable(train, "train")
+    assert path == "train.__closure__['model']"
+    assert leaf is model
+    assert isinstance(err, TypeError)
+
+
+def test_nested_container_path():
+    bad = {"cfg": [1, {"sock": threading.Lock()}]}
+    path, leaf, _err = find_unserializable(bad, "obj")
+    assert path == "obj['cfg'][1]['sock']"
+
+
+def test_attribute_path():
+    class Holder:
+        def __init__(self):
+            self.name = "h"
+            self.state = {"inner": threading.Lock()}
+
+    path, _leaf, _err = find_unserializable(Holder(), "holder")
+    assert path == "holder.state['inner']"
+
+
+def test_check_raises_with_path_and_remedy():
+    with pytest.raises(SerializationTrapError) as info:
+        check_serializability({"model": threading.Lock()}, "obj")
+    message = str(info.value)
+    assert "obj['model']" in message
+    assert "lock" in message.lower()
+    assert info.value.path == "obj['model']"
+
+
+def test_trap_error_is_typeerror_and_picklable():
+    err = SerializationTrapError("obj.x", "<lock>", "TypeError(...)")
+    assert isinstance(err, TypeError)
+    import pickle
+
+    clone = pickle.loads(pickle.dumps(err))
+    assert clone.path == "obj.x"
+
+
+def test_failed_submit_frees_earlier_arg_segments(ray_start_regular):
+    """A later arg failing to pickle must not leak the shm segments
+    already written for earlier (large) args — the spec is never
+    submitted, so the normal task-end free never runs."""
+    import numpy as np
+
+    rt = ray_start_regular
+
+    @ray.remote
+    def f(x, y):
+        return x
+
+    big = np.zeros(1 << 20, dtype=np.uint8)  # well past max_inline
+    before = set(rt.shm._created)
+    for _ in range(3):
+        with pytest.raises(SerializationTrapError):
+            f.remote(big, threading.Lock())
+    assert set(rt.shm._created) == before, (
+        "failed submits leaked shm segments")
+
+
+def test_failed_submit_frees_spill_files_without_shm_acct(tmp_path):
+    """Store-full args spill to DISK paths; the failed-submit cleanup
+    must plain-unlink those, not route them through ShmStore.unlink
+    (which would debit node-shared shm accounting for bytes never
+    charged to it)."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.shm_store import ShmStore
+    from ray_tpu.remote_function import serialize_args
+
+    store = ShmStore(shm_dir=str(tmp_path), session_id="spilltest",
+                     capacity=1 << 30)
+    # Charge real bytes first so a bogus debit would be visible.
+    store.create_from_parts(ObjectID.from_random(), b"m",
+                            [memoryview(b"x" * 4096)])
+    charged = store._node_used()
+    assert charged > 0
+    spill = tmp_path / "spill-seg"
+    spill.write_bytes(b"y" * 1024)
+
+    class StubRT:
+        shm = store
+
+        def begin_ref_collection(self):
+            pass
+
+        def end_ref_collection(self):
+            return []
+
+        def serialize_value(self, value, oid):
+            if value == "big":
+                return ("spilled", str(spill), 1024, "store-1")
+            raise TypeError("cannot pickle _thread.lock")
+
+    with pytest.raises(SerializationTrapError):
+        serialize_args(StubRT(), ["big", threading.Lock()], {}, {})
+    assert not spill.exists(), "spill file leaked by failed submit"
+    assert store._node_used() == charged, "shm accounting was debited"
+    store.cleanup()
+
+
+def test_devtools_not_imported_by_default():
+    """`import ray_tpu` keeps devtools off the import path (it loads
+    lazily on ray_tpu.util.check_serializability use or under
+    RAY_TPU_LOCKCHECK); guards the laziness the error-path imports rely
+    on."""
+    import subprocess
+    import sys
+
+    code = (
+        "import ray_tpu, sys;"
+        "assert 'ray_tpu.devtools' not in sys.modules, 'eager devtools';"
+        "from ray_tpu.util import check_serializability;"
+        "assert 'ray_tpu.devtools.serializability' in sys.modules;"
+        "print('LAZY_OK')"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "LAZY_OK" in proc.stdout
+
+
+def test_remote_submit_failures_name_leaf(ray_start_regular):
+    """One runtime boot covers the three @remote wiring paths: positional
+    arg, kwarg, and the function payload's own closure."""
+    @ray.remote
+    def f(x, y=None):
+        return x
+
+    class Config:
+        def __init__(self):
+            self.lr = 0.1
+            self.lock = threading.Lock()
+
+    with pytest.raises(SerializationTrapError) as info:
+        f.remote(1, Config())
+    assert info.value.path == "arg[1].lock"
+
+    with pytest.raises(SerializationTrapError) as info:
+        f.remote(x=Config())
+    assert info.value.path == "kwargs['x'].lock"
+
+    resource = threading.Lock()
+
+    @ray.remote
+    def uses_resource():
+        return resource
+
+    with pytest.raises(SerializationTrapError) as info:
+        uses_resource.remote()
+    assert info.value.path == "uses_resource.__closure__['resource']"

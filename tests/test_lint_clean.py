@@ -1,0 +1,32 @@
+"""Lint-cleanliness gate: the shipped tree must carry zero un-suppressed
+framework-lint findings, so a regression fails plain `pytest tests/`
+without a separate CI job (the `python -m ray_tpu.devtools.lint ray_tpu/`
+CLI is the same engine)."""
+
+import os
+
+import ray_tpu
+from ray_tpu.devtools import lint
+
+PKG_DIR = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _format(findings):
+    return "\n".join(repr(f) for f in findings)
+
+
+def test_ray_tpu_tree_is_lint_clean():
+    findings = lint.lint_paths([PKG_DIR])
+    assert findings == [], (
+        "ray_tpu/ has un-suppressed lint findings (fix them, or add "
+        "'# noqa: <RULE-ID> -- reason' where the pattern is deliberate):\n"
+        + _format(findings))
+
+
+def test_test_tree_is_lint_clean():
+    # lint_paths' directory walk already skips lint_fixtures/ (the
+    # linter's own deliberately-bad corpus), so the whole tests/ tree —
+    # the documented `lint ray_tpu/ tests/` invocation — must be clean.
+    findings = lint.lint_paths([TESTS_DIR])
+    assert findings == [], _format(findings)
