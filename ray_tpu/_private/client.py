@@ -108,21 +108,28 @@ def client_connect(address: str, authkey: bytes,
     assert msg[0] == "client_ack", msg
     rt.store_id = f"client-{os.urandom(4).hex()}"  # nothing shares it
 
+    def handle(m):
+        tag = m[0]
+        if protocol.is_batch(m):
+            # Conflation-sender frame from the head: unwrap in order.
+            for sub in m[1]:
+                handle(sub)
+        elif tag == "obj":
+            rt.deliver_reply(m[1], (m[2], m[3]))
+        elif tag == "mgot":
+            rt.deliver_reply(m[1], m[2])
+        elif tag == "waited":
+            rt.deliver_reply(m[1], m[2])
+        elif tag == "reply":
+            rt.deliver_reply(m[1], m[2])
+
     def reader():
         while True:
             try:
                 m = protocol.recv(conn)
             except (EOFError, OSError, TypeError):
                 return
-            tag = m[0]
-            if tag == "obj":
-                rt.deliver_reply(m[1], (m[2], m[3]))
-            elif tag == "mgot":
-                rt.deliver_reply(m[1], m[2])
-            elif tag == "waited":
-                rt.deliver_reply(m[1], m[2])
-            elif tag == "reply":
-                rt.deliver_reply(m[1], m[2])
+            handle(m)
 
     threading.Thread(target=reader, daemon=True,
                      name="ray_tpu-client-reader").start()

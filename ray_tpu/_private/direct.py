@@ -81,7 +81,8 @@ class _Lease:
     """One leased executor worker + its direct connection."""
 
     __slots__ = ("worker_id", "addr", "conn", "send_lock", "inflight",
-                 "funcs_sent", "dead", "idle_since", "klass")
+                 "funcs_sent", "dead", "idle_since", "klass",
+                 "outbuf", "buf_lock")
 
     def __init__(self, worker_id: str, addr, klass):
         self.worker_id = worker_id
@@ -93,10 +94,42 @@ class _Lease:
         self.dead = False
         self.idle_since = time.monotonic()
         self.klass = klass
+        # Conflation-sender buffer: pushes append here (buf_lock only)
+        # while a flush's pickle+write runs under send_lock — appenders
+        # never block on an in-flight write, which is what lets batches
+        # self-clock with no added latency floor.
+        self.outbuf: List[tuple] = []
+        self.buf_lock = threading.Lock()
 
     def send(self, msg):
         with self.send_lock:
             protocol.send(self.conn, msg)
+
+    def queue_msgs(self, msgs):
+        with self.buf_lock:
+            self.outbuf.extend(msgs)
+
+    def flush_buffered(self):
+        with self.buf_lock:
+            if not self.outbuf:
+                return
+            msgs, self.outbuf = self.outbuf, []
+        # Merge the buffered dexec/dexec_batch frames into ONE
+        # dexec_batch (dfuncs keep their position before the first exec
+        # that needs them), then ship everything as one pickle + write.
+        pre, execs = [], []
+        for m in msgs:
+            if m[0] == "dexec":
+                execs.append((m[1], m[2]))
+            elif m[0] == "dexec_batch":
+                execs.extend(m[1])
+            else:
+                pre.append(m)
+        if execs:
+            pre.append(("dexec", execs[0][0], execs[0][1])
+                       if len(execs) == 1 else ("dexec_batch", execs))
+        with self.send_lock:
+            protocol.send_batch(self.conn, pre)
 
 
 class DirectCaller:
@@ -131,6 +164,15 @@ class DirectCaller:
         # direct | head ("head" is sticky: once any call routes through
         # the head, later calls do too, preserving per-caller order).
         self.actor_channels: Dict[bytes, dict] = {}
+        # Conflation sender for direct pushes: _push_group buffers per
+        # lease and this thread flushes; while one flush's pickle+write
+        # runs, later submissions coalesce into the next batch — a
+        # fan-out burst costs ~1 syscall per batch instead of one per
+        # task (reference: gRPC stream write coalescing on PushTask).
+        self._dirty_leases: set = set()
+        self._lease_dirty_lock = threading.Lock()
+        self._send_event = threading.Event()
+        self._sender_thread = None
 
     # ------------------------------------------------------------- owned --
     def register_put(self, oid: ObjectID, descr, nested_local, nested_head):
@@ -247,23 +289,34 @@ class DirectCaller:
             return
         with self.lock:
             out, self._outbound = self._outbound, []
+        # Consecutive head-bound messages coalesce into one ("batch", ...)
+        # envelope (relative order with lease-bound frees is preserved by
+        # flushing in segments) — a result burst's decref storm becomes
+        # one pickle + one write.
+        head_buf: List[tuple] = []
+
+        def flush_head():
+            if not head_buf:
+                return
+            msgs, head_buf[:] = list(head_buf), []
+            try:
+                self.host.head_send(protocol.make_batch(msgs))
+            except Exception:
+                pass
+
         for item in out:
             if item[0] == "lease":
+                flush_head()
                 _kind, lease, msg, fallback = item
                 try:
                     lease.send(msg)
                     continue
                 except Exception:
                     pass
-                try:
-                    self.host.head_send(fallback)
-                except Exception:
-                    pass
+                head_buf.append(fallback)
             else:
-                try:
-                    self.host.head_send(item[1])
-                except Exception:
-                    pass
+                head_buf.append(item[1])
+        flush_head()
 
     # ------------------------------------------------------------ submit --
     def eligible(self, spec: dict) -> bool:
@@ -339,19 +392,31 @@ class DirectCaller:
         dependency resolution: the spec is held until every owned ref arg
         is READY (reference: the caller's LocalDependencyResolver,
         direct_task_transport.cc:33)."""
-        klass = self._sched_class(spec)
+        return self.submit_many([spec])[0]
+
+    def submit_many(self, specs: List[dict]) -> List[List[OwnedState]]:
+        """Bulk submission: every spec's owned returns / arg pins
+        register under ONE ownership-lock pass, then each scheduling
+        class pumps once for the whole batch (reference: the amortized
+        per-SchedulingKey submission of direct_task_transport.cc)."""
+        states_out: List[List[OwnedState]] = []
+        klasses: List[tuple] = []
         with self.lock:
-            entry, states = self._register_entry_locked(
-                spec, spec.get("max_retries", 3))
-            if entry["deps"] == 0:
-                self._pool_locked(klass)["queue"].append(entry)
+            for spec in specs:
+                entry, states = self._register_entry_locked(
+                    spec, spec.get("max_retries", 3))
+                states_out.append(states)
+                if entry["deps"] == 0:
+                    klass = self._sched_class(spec)
+                    self._pool_locked(klass)["queue"].append(entry)
+                    klasses.append(klass)
         # Flush BEFORE returning to user code: the foreign-nested addref
         # must be on the wire before the user can drop their own ref
         # (whose buffered decref rides a later send on the same conn).
         self._flush_outbound()
-        if entry["deps"] == 0:
+        for klass in dict.fromkeys(klasses):
             self._pump(klass)
-        return states
+        return states_out
 
     def _sched_class(self, spec) -> tuple:
         res = spec.get("resources") or {"CPU": 1.0}
@@ -411,10 +476,11 @@ class DirectCaller:
                 daemon=True).start()
 
     def _push_group(self, lease: _Lease, entries: List[dict]):
-        """Push a burst of entries to one lease as ONE wire message
-        (``dexec_batch``) — per-task sends made the push path syscall- and
-        pickle-bound under multi-client load (reference: gRPC stream write
-        coalescing on the PushTask stream)."""
+        """Queue a burst of entries for the conflation sender.  The
+        sender ships everything buffered per lease as ONE wire frame —
+        per-task sends made the push path syscall- and pickle-bound
+        under multi-client load (reference: gRPC stream write coalescing
+        on the PushTask stream)."""
         tasks, failed = [], []
         for entry in entries:
             try:
@@ -429,21 +495,45 @@ class DirectCaller:
                 self._fail_entry(entry, e)
         if not tasks:
             return
-        try:
-            for entry, _task in tasks:
-                fid = entry["spec"].get("func_id")
-                if fid and fid not in lease.funcs_sent:
-                    payload = self.host.get_payload(fid)
-                    if payload is not None:
-                        lease.send(("dfunc", fid, payload))
-                    lease.funcs_sent.add(fid)
-            if len(tasks) == 1:
-                lease.send(("dexec", tasks[0][0]["rid"], tasks[0][1]))
-            else:
-                lease.send(("dexec_batch",
-                            [(e["rid"], t) for e, t in tasks]))
-        except Exception:
-            self._on_lease_dead(lease)
+        msgs = []
+        for entry, _task in tasks:
+            fid = entry["spec"].get("func_id")
+            if fid and fid not in lease.funcs_sent:
+                payload = self.host.get_payload(fid)
+                if payload is not None:
+                    msgs.append(("dfunc", fid, payload))
+                lease.funcs_sent.add(fid)
+        if len(tasks) == 1:
+            msgs.append(("dexec", tasks[0][0]["rid"], tasks[0][1]))
+        else:
+            msgs.append(("dexec_batch", [(e["rid"], t) for e, t in tasks]))
+        lease.queue_msgs(msgs)
+        self._mark_lease_dirty(lease)
+
+    def _mark_lease_dirty(self, lease: _Lease):
+        with self._lease_dirty_lock:
+            self._dirty_leases.add(lease)
+            if self._sender_thread is None:
+                self._sender_thread = threading.Thread(
+                    target=self._lease_sender_loop, daemon=True,
+                    name="ray_tpu-direct-sender")
+                self._sender_thread.start()
+        self._send_event.set()
+
+    def _lease_sender_loop(self):
+        """Flush dirty leases' push buffers.  Self-clocking: while one
+        flush's pickle+write runs here, the submitting thread keeps
+        appending to the next batch."""
+        while not self._stopped:
+            self._send_event.wait()
+            self._send_event.clear()
+            with self._lease_dirty_lock:
+                dirty, self._dirty_leases = self._dirty_leases, set()
+            for lease in dirty:
+                try:
+                    lease.flush_buffered()
+                except Exception:
+                    self._on_lease_dead(lease)
 
     def _build_task(self, spec: dict) -> dict:
         """Spec -> executable task dict: owned ref args substituted with
@@ -1195,6 +1285,7 @@ class DirectCaller:
 
     def shutdown(self):
         self._stopped = True
+        self._send_event.set()  # unblock the push sender's exit
         with self.lock:
             leases = [l for p in self.pools.values() for l in p["leases"]]
         for lease in leases:
@@ -1279,36 +1370,43 @@ class DirectServer:
                 except Exception:
                     pass
                 return
-            tag = msg[0]
-            if tag == "dexec":
-                task = msg[2]
-                task["_dreply"] = (src, msg[1])
-                src.note_enqueued(1)
-                self._enqueue(task, src)
-            elif tag == "dexec_batch":
-                src.note_enqueued(len(msg[1]))
-                for rid, task in msg[1]:
-                    task["_dreply"] = (src, rid)
-                    self._enqueue(task, src)
-            elif tag == "dfunc":
-                self._register_func(msg[1], msg[2])
-            elif tag == "dfree":
-                try:
-                    self._shm_unlink(msg[1], msg[2], msg[3])
-                except Exception:
-                    pass
-            elif tag == "dmsg":
-                # Generic peer-to-peer message (host-tier ring
-                # collectives ride this; reference: the Gloo transport's
-                # peer channels).  (channel, payload) dispatched to the
-                # process-local handler registry.
-                if self._on_peer_msg is not None:
-                    try:
-                        self._on_peer_msg(msg[1], msg[2])
-                    except Exception:
-                        import traceback
+            if protocol.is_batch(msg):
+                for m in msg[1]:
+                    self._handle_direct_msg(m, src)
+            else:
+                self._handle_direct_msg(msg, src)
 
-                        traceback.print_exc()
+    def _handle_direct_msg(self, msg, src):
+        tag = msg[0]
+        if tag == "dexec":
+            task = msg[2]
+            task["_dreply"] = (src, msg[1])
+            src.note_enqueued(1)
+            self._enqueue(task, src)
+        elif tag == "dexec_batch":
+            src.note_enqueued(len(msg[1]))
+            for rid, task in msg[1]:
+                task["_dreply"] = (src, rid)
+                self._enqueue(task, src)
+        elif tag == "dfunc":
+            self._register_func(msg[1], msg[2])
+        elif tag == "dfree":
+            try:
+                self._shm_unlink(msg[1], msg[2], msg[3])
+            except Exception:
+                pass
+        elif tag == "dmsg":
+            # Generic peer-to-peer message (host-tier ring
+            # collectives ride this; reference: the Gloo transport's
+            # peer channels).  (channel, payload) dispatched to the
+            # process-local handler registry.
+            if self._on_peer_msg is not None:
+                try:
+                    self._on_peer_msg(msg[1], msg[2])
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
 
     def close(self):
         self._stopped = True

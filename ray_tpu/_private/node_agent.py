@@ -54,6 +54,13 @@ class NodeAgent:
         self.send_lock = threading.Lock()
         self.workers: Dict[str, subprocess.Popen] = {}
         self.session = ""
+        # Set once the head's agent_ack has been processed.  The memory
+        # monitor gates on THIS, not on the config dict's truthiness — an
+        # empty {} handshake payload must still arm the monitor (gating
+        # on the dict left the thread spinning forever and the remote OOM
+        # monitor silently disabled).
+        self.head_config: Dict = {}
+        self._handshake_done = threading.Event()
         self._stopped = False
         # Object server: direct chunked pulls from this node's store
         # (reference: the per-node object manager's transfer port).
@@ -109,9 +116,9 @@ class NodeAgent:
         from ray_tpu._private.config import Config
 
         env_cfg = Config.from_env()
-        while not self._stopped and not getattr(self, "head_config", None):
-            time.sleep(0.2)  # wait for the agent_ack
-        head_cfg = getattr(self, "head_config", {}) or {}
+        while not self._stopped and not self._handshake_done.wait(0.2):
+            pass  # wait for the agent_ack (explicit handshake flag)
+        head_cfg = self.head_config
 
         def knob(name):
             env_val = getattr(env_cfg, name)
@@ -164,8 +171,11 @@ class NodeAgent:
         assert msg[0] == "agent_ack", msg
         self.node_id_hex = msg[1]
         self.session = msg[2]
-        # Head-pushed config this node mirrors (memory monitor knobs).
+        # Head-pushed config this node mirrors (memory monitor knobs);
+        # the event marks handshake completion even when the payload is
+        # empty (see _memory_monitor).
         self.head_config = msg[3] if len(msg) > 3 else {}
+        self._handshake_done.set()
         # Attach-only store for read_segment (segments here are created by
         # this node's workers; the agent never allocates).
         self.store = ShmStore(shm_dir=self.shm_dir, session_id=self.session)

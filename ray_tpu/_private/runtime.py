@@ -97,7 +97,7 @@ class TaskRecord:
     __slots__ = (
         "spec", "requirements", "deps_pending", "retries_left", "node",
         "worker", "dispatched", "cancelled", "is_actor_creation", "actor_id",
-        "pg_id", "bundle_index",
+        "pg_id", "bundle_index", "sched_key",
     )
 
     def __init__(self, spec, requirements, retries_left):
@@ -113,6 +113,10 @@ class TaskRecord:
         self.actor_id: Optional[bytes] = None
         self.pg_id: Optional[PlacementGroupID] = None
         self.bundle_index: Optional[int] = None
+        # Scheduling-class tuple, computed once at first enqueue (the
+        # spec's strategy/env/requirements never change afterwards) so
+        # re-enqueues, cancels and dispatch scans are dict ops only.
+        self.sched_key: Optional[tuple] = None
 
 
 ALIVE, RESTARTING, DEAD = "ALIVE", "RESTARTING", "DEAD"
@@ -228,11 +232,20 @@ class WorkerHandle:
             if not self.outbuf:
                 return
             msgs, self.outbuf = self.outbuf, []
-            payload = msgs[0] if len(msgs) == 1 else ("msg_batch", msgs)
+            payload = protocol.make_batch(msgs)
             if self.conn is None:
                 self.outbox.append(payload)
             else:
-                protocol.send(self.conn, payload)
+                try:
+                    protocol.send(self.conn, payload)
+                except BaseException:
+                    # Failed delivery is how worker death is usually
+                    # discovered: put the batch back (send_lock is held,
+                    # so order is preserved) so the death path can
+                    # reroute buffered free_segment messages to their
+                    # store-side fallback instead of leaking segments.
+                    self.outbuf[:0] = msgs
+                    raise
 
     def attach(self, conn):
         with self.send_lock:
@@ -474,13 +487,17 @@ class Runtime:
         self._worker_logs: Dict[str, deque] = {}
         threading.Thread(target=self._log_monitor_loop, daemon=True,
                          name="ray_tpu-logmon").start()
-        # Conflation sender: dispatches buffer exec/func messages per
-        # worker; this thread flushes them as msg_batch frames.  While
-        # one flush's pickle+write runs, later dispatches coalesce into
-        # the next batch — a burst of .remote() calls costs ~1 syscall
-        # per batch instead of one per task.
+        # Conflation sender: dispatches buffer task-path messages (exec/
+        # func/obj/mgot/free_segment/reply) per worker; this thread
+        # flushes them as ("batch", ...) frames.  While one flush's
+        # pickle+write runs, later dispatches coalesce into the next
+        # batch — a burst of .remote() calls costs ~1 syscall per batch
+        # instead of one per task.  The dirty set has its own leaf lock
+        # so reply paths running off the IO threads don't contend on (or
+        # need) the big runtime lock just to mark a worker dirty.
         self._sender_event = threading.Event()
         self._dirty_workers: set = set()
+        self._dirty_lock = threading.Lock()
         # Client lease requests waiting for capacity (reference: the
         # raylet's queued RequestWorkerLease); serviced by _dispatch_locked
         # on every resource release, expired by a per-request timer.
@@ -519,13 +536,25 @@ class Runtime:
         while not self._stopped:
             self._sender_event.wait()
             self._sender_event.clear()
-            with self.lock:
+            with self._dirty_lock:
                 dirty, self._dirty_workers = self._dirty_workers, set()
             for w in dirty:
                 try:
                     w.flush_buffered()
                 except Exception:
                     self._on_worker_death(w)
+
+    def _mark_dirty(self, worker: "WorkerHandle"):
+        with self._dirty_lock:
+            self._dirty_workers.add(worker)
+        self._sender_event.set()
+
+    def _queue_send(self, worker: "WorkerHandle", msg: tuple):
+        """Buffer ``msg`` for the conflation sender.  Back-to-back sends
+        to one worker (a burst of mgot/obj replies, frees, execs) leave
+        as one ("batch", ...) pickle + one write."""
+        worker.queue_msg(msg)
+        self._mark_dirty(worker)
 
     # ------------------------------------------------------------- nodes --
     def _add_node_locked(self, resources, labels=None, agent=None,
@@ -716,12 +745,13 @@ class Runtime:
                     # A worker's store created the segment: route the free
                     # there so its pages can be pooled for in-place reuse
                     # (shipped segments may be mapped elsewhere — the worker
-                    # then just closes + unlinks).
-                    try:
-                        cw.send(("free_segment", st.descr[1],
-                                 st.descr[2], not st.shipped))
-                    except Exception:
-                        cw = None  # fall through to store-based free
+                    # then just closes + unlinks).  Conflated: a burst of
+                    # frees rides one ("batch", ...) frame.  Queueing
+                    # cannot fail; if delivery later fails, the worker-
+                    # death path reroutes buffered frees to the store-
+                    # side fallback (_reroute_dead_worker_frees_locked).
+                    self._queue_send(cw, ("free_segment", st.descr[1],
+                                          st.descr[2], not st.shipped))
                 if cw is None or cw.dead:
                     if home == self.store_id:
                         self.shm.unlink(st.descr[1], st.descr[2],
@@ -830,11 +860,8 @@ class Runtime:
                     if creator is not None and not creator.dead:
                         # The creating worker may still hold the (now
                         # deleted) file's pages mapped in its pool: let go.
-                        try:
-                            creator.send(("free_segment", name, size,
-                                          False))
-                        except Exception:
-                            pass
+                        self._queue_send(creator, ("free_segment",
+                                                   name, size, False))
                 self._maybe_free_locked(oid, st)
         return freed
 
@@ -1211,47 +1238,101 @@ class Runtime:
     def submit_task(self, spec: dict):
         """Entry from RemoteFunction._remote (reference:
         python/ray/remote_function.py:241 → core_worker.cc:1819 SubmitTask)."""
+        return self.submit_tasks([spec])[0]
+
+    def submit_tasks(self, specs: List[dict]):
+        """Bulk submission: register every spec under ONE lock
+        acquisition, then run ONE dispatch pass (and one pump per
+        distinct actor) over the whole batch — a fan-out burst pays
+        O(1) lock/dispatch instead of O(n) (reference: the per-
+        SchedulingKey amortization in direct_task_transport.cc).
+        Returns one list of ObjectRefs per spec."""
         from ray_tpu._private.object_ref import ObjectRef
 
-        tid = TaskID(spec["task_id"])
-        req = spec.get("resources") or {"CPU": 1.0}
-        rec = TaskRecord(spec, req, spec.get("max_retries",
-                                             self.config.default_max_retries))
-        _apply_strategy(rec, spec)
-        refs = []
-        with self.lock:
-            for i in range(spec["num_returns"]):
-                oid = tid.object_id(i)
-                st = self.objects.get(oid)
-                if st is None:
-                    st = self.objects[oid] = ObjectState(tid)
-                else:
-                    st.task_id = tid
-                # Count the caller's reference NOW, under the lock — the
-                # ObjectRef below is built with _register=False.  Otherwise
-                # a fast task could complete (IO thread) and be freed before
-                # the caller's ref registers (the classic ownership race;
-                # reference: reference_count.cc AddOwnedObject happens
-                # atomically with submission).
-                st.local_refs += 1
-            self.tasks[spec["task_id"]] = rec
-            # SUBMITTED must precede the RUNNING event that dispatch may
-            # append below — state queries take the latest event per task.
-            self.task_events.append(
+        self._submit_specs(specs, from_worker=False)
+        out = []
+        for spec in specs:
+            tid = TaskID(spec["task_id"])
+            out.append([ObjectRef(tid.object_id(i), _register=False)
+                        for i in range(spec["num_returns"])])
+        return out
+
+    def _submit_specs(self, specs: List[dict], *, from_worker: bool,
+                      submitter=None):
+        """Shared bulk-registration core for driver submissions and the
+        worker/client ("submit"/"submit_batch") path.  Per-spec
+        invariants (TaskRecord, strategy parse, SUBMITTED event dicts,
+        one shared timestamp) are built OUTSIDE the lock; only table
+        writes run inside it, followed by one dispatch pass and one
+        pump per distinct actor."""
+        now = time.time()
+        recs = []
+        events = []
+        for spec in specs:
+            if from_worker and submitter is not None \
+                    and spec.get("tmp_segments"):
+                # The submitting worker's store created any by-value arg
+                # segments in tmp_segments; frees are routed back there
+                # (segment-pool reuse).
+                spec["_creator_worker"] = submitter
+            req = spec.get("resources") or {"CPU": 1.0}
+            rec = TaskRecord(spec, req,
+                             spec.get("max_retries",
+                                      self.config.default_max_retries))
+            _apply_strategy(rec, spec)
+            recs.append(rec)
+            events.append(
                 {"task_id": spec["task_id"].hex(),
                  "name": spec.get("name"),
-                 "state": "SUBMITTED", "time": time.time()})
-            self._register_lineage_locked(spec)
-            self._pin_nested_locked(spec.get("nested_refs", []))
-            self._resolve_deps_locked(rec)
-            if "actor_id" in spec:
-                self._enqueue_actor_task_locked(rec)
-            elif rec.deps_pending == 0:
-                self._enqueue_pending_locked(rec)
+                 "state": "SUBMITTED", "time": now})
+        with self.lock:
+            dispatch = False
+            actor_ids: List[bytes] = []
+            for rec, ev in zip(recs, events):
+                spec = rec.spec
+                tid = TaskID(spec["task_id"])
+                for i in range(spec["num_returns"]):
+                    oid = tid.object_id(i)
+                    st = self.objects.get(oid)
+                    if st is None:
+                        st = self.objects[oid] = ObjectState(tid)
+                    else:
+                        st.task_id = tid
+                    # Count the submitter's reference NOW, under the lock
+                    # — its ObjectRefs are built with _register=False
+                    # (the driver's own, or the worker's whose __del__
+                    # decrefs pair with this).  Otherwise a fast task
+                    # could complete (IO thread) and be freed before the
+                    # submitter's ref registers (the classic ownership
+                    # race; reference: reference_count.cc AddOwnedObject
+                    # happens atomically with submission).
+                    if from_worker:
+                        st.worker_refs += 1
+                    else:
+                        st.local_refs += 1
+                if from_worker and spec.get("func_payload") is not None:
+                    fid = spec["func_id"]
+                    self.functions.setdefault(fid,
+                                              spec.pop("func_payload"))
+                self.tasks[spec["task_id"]] = rec
+                # SUBMITTED must precede the RUNNING event that dispatch
+                # may append below — state queries take the latest event
+                # per task.
+                self.task_events.append(ev)
+                self._register_lineage_locked(spec)
+                self._pin_nested_locked(spec.get("nested_refs", []))
+                self._resolve_deps_locked(rec)
+                if "actor_id" in spec:
+                    aid = self._enqueue_actor_task_nopump_locked(rec)
+                    if aid is not None:
+                        actor_ids.append(aid)
+                elif rec.deps_pending == 0:
+                    self._enqueue_pending_locked(rec)
+                    dispatch = True
+            for aid in dict.fromkeys(actor_ids):
+                self._pump_actor_locked(self.actors[aid])
+            if dispatch:
                 self._dispatch_locked()
-        for i in range(spec["num_returns"]):
-            refs.append(ObjectRef(tid.object_id(i), _register=False))
-        return refs
 
     def _resolve_deps_locked(self, rec: TaskRecord):
         spec = rec.spec
@@ -1401,8 +1482,9 @@ class Runtime:
                 rec.pg_id, rec.bundle_index, skey, marker, ekey)
 
     def _enqueue_pending_locked(self, rec: "TaskRecord"):
-        self.pending_tasks.setdefault(
-            self._sched_class(rec), deque()).append(rec)
+        if rec.sched_key is None:
+            rec.sched_key = self._sched_class(rec)
+        self.pending_tasks.setdefault(rec.sched_key, deque()).append(rec)
 
     def _dispatch_locked(self):
         """Assign queued tasks to workers.  Two-step per scheduling class,
@@ -1893,9 +1975,7 @@ class Runtime:
             if granted:
                 self._finish_client_grant(p["lessee"], p["rid"], granted)
             elif now >= p["deadline"]:
-                p["lessee"].queue_msg(("reply", p["rid"], []))
-                self._dirty_workers.add(p["lessee"])
-                self._sender_event.set()
+                self._queue_send(p["lessee"], ("reply", p["rid"], []))
             else:
                 still.append(p)
         self._pending_client_leases = still
@@ -1976,7 +2056,6 @@ class Runtime:
         func_id = spec.get("func_id")
         if func_id and func_id not in sent:
             worker.queue_msg(("func", func_id, self.functions[func_id]))
-            self._dirty_workers.add(worker)
             sent.add(func_id)
         if rec.is_actor_creation:
             actor = self.actors[rec.actor_id]
@@ -1992,8 +2071,7 @@ class Runtime:
             }))
         else:
             worker.queue_msg(("exec", msg_task))
-        self._dirty_workers.add(worker)
-        self._sender_event.set()
+        self._mark_dirty(worker)
         self.task_events.append(
             {"task_id": spec["task_id"].hex(), "name": spec.get("name"),
              "state": "RUNNING", "time": time.time()})
@@ -2054,11 +2132,11 @@ class Runtime:
                     pass
                 continue
             if creator is not None and not creator.dead:
-                try:
-                    creator.send(("free_segment", name, size, False))
-                    continue
-                except Exception:
-                    pass
+                # Queueing cannot fail; undeliverable frees reroute via
+                # the creator's death path.
+                self._queue_send(creator,
+                                 ("free_segment", name, size, False))
+                continue
             self.shm.unlink(name, size)
         spec["tmp_segments"] = []
 
@@ -2205,16 +2283,25 @@ class Runtime:
                 print(f"ray_tpu: could not restore actor "
                       f"{info['name']!r}: {e!r}")
 
-    def _enqueue_actor_task_locked(self, rec: TaskRecord):
+    def _enqueue_actor_task_nopump_locked(
+            self, rec: TaskRecord) -> Optional[bytes]:
+        """Queue an actor task without pumping; returns the actor id (or
+        None for a dead actor) so bulk submitters can pump each distinct
+        actor once per batch instead of once per call."""
         rec.actor_id = rec.spec["actor_id"]
         actor = self.actors.get(rec.actor_id)
         if actor is None or actor.status == DEAD:
             cause = actor.death_cause if actor else None
             self._fail_task_locked(rec, exc.ActorDiedError(
                 f"Actor is dead: {cause}"))
-            return
+            return None
         actor.queue.append(rec)
-        self._pump_actor_locked(actor)
+        return rec.actor_id
+
+    def _enqueue_actor_task_locked(self, rec: TaskRecord):
+        aid = self._enqueue_actor_task_nopump_locked(rec)
+        if aid is not None:
+            self._pump_actor_locked(self.actors[aid])
 
     def _pump_actor_locked(self, actor: ActorState):
         if actor.status != ALIVE or actor.worker is None:
@@ -2565,6 +2652,18 @@ class Runtime:
         (reference: src/ray/common/event_stats.h — per-handler event
         stats; this is the instrumentation that shows WHERE head time
         goes under load)."""
+        if protocol.is_batch(msg):
+            # Wire-batch envelope: unwrap so each sub-message keeps its
+            # own handler stats AND its own failure isolation — a bad
+            # sub-message must not abort the rest of the frame (they
+            # were independent messages before batching).
+            for m in msg[1]:
+                try:
+                    self._handle_worker_msg(worker, m)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+            return
         t0 = time.perf_counter()
         try:
             return self._handle_worker_msg_inner(worker, msg)
@@ -2650,6 +2749,11 @@ class Runtime:
                     # driver-local use); pickling the reply needs bytes.
                     bufs = [b if isinstance(b, bytes) else bytes(b)
                             for b in bufs]
+                    # Direct send, NOT the conflation sender: this reply
+                    # can carry hundreds of MB of PARTS bytes, and this
+                    # fetch thread is already per-request — funneling it
+                    # through the one sender thread would head-of-line
+                    # block exec dispatch to every other worker.
                     worker.send(("obj", rid, True,
                                  (protocol.PARTS, meta, bufs)))
                 except BaseException as e:  # noqa: BLE001
@@ -2670,7 +2774,8 @@ class Runtime:
                         if (st := self.objects.get(ObjectID(b))) is not None
                         and st.status != PENDING
                     ]
-                worker.send(("waited", rid, ready_ids[:num_returns]))
+                self._queue_send(worker,
+                                 ("waited", rid, ready_ids[:num_returns]))
 
             count = {"ready": 0, "sent": False}
             with self.lock:
@@ -2696,9 +2801,7 @@ class Runtime:
                                  if not r.is_actor_creation]
                     if stealable:
                         try:
-                            worker.queue_msg(("steal", 0, stealable))
-                            self._dirty_workers.add(worker)
-                            self._sender_event.set()
+                            self._queue_send(worker, ("steal", 0, stealable))
                         except Exception:
                             pass
                     def cb(_oid):
@@ -2722,13 +2825,17 @@ class Runtime:
             # refs locally; per-connection FIFO guarantees any later use
             # of them arrives after this spec.
             self.submit_task_from_worker(msg[2], submitter=worker)
+        elif tag == "submit_batch":
+            # Bulk fire-and-forget submission (worker/client fan-out):
+            # one lock pass + one dispatch for the whole list.
+            self.submit_tasks_from_worker(msg[1], submitter=worker)
         elif tag == "create_actor_req":
             _, rid, spec, creation_opts = msg
             try:
                 actor_id = self.create_actor(spec, creation_opts)
-                worker.send(("reply", rid, actor_id))
+                self._queue_send(worker, ("reply", rid, actor_id))
             except Exception as e:  # noqa: BLE001
-                worker.send(("reply", rid, e))
+                self._queue_send(worker, ("reply", rid, e))
         elif tag == "store_addr":
             # Location brokering only (reference: the owner-based object
             # directory answering WHERE, never carrying bytes).
@@ -2737,27 +2844,28 @@ class Runtime:
                 agent = self._agents.get(store_hex)
                 addr = (agent.info.get("object_addr")
                         if agent is not None and not agent.dead else None)
-            worker.send(("reply", rid, addr))
+            self._queue_send(worker, ("reply", rid, addr))
         elif tag == "state_req":
             _, rid, kind, kwargs = msg
             try:
-                worker.send(("reply", rid,
-                             self.state_query(kind, **kwargs)))
+                self._queue_send(
+                    worker, ("reply", rid, self.state_query(kind, **kwargs)))
             except Exception as e:  # noqa: BLE001
-                worker.send(("reply", rid, e))
+                self._queue_send(worker, ("reply", rid, e))
         elif tag == "kill_actor_req":
             _, rid, actor_id, no_restart = msg
             self.kill_actor(actor_id, no_restart)
-            worker.send(("reply", rid, True))
+            self._queue_send(worker, ("reply", rid, True))
         elif tag == "get_actor_req":
             _, rid, name, namespace = msg
             try:
                 actor_id, actor = self.get_named_actor(name, namespace)
-                worker.send(("reply", rid,
+                self._queue_send(
+                    worker, ("reply", rid,
                              (True, actor_id,
                               actor.options.get("method_names", {}))))
             except ValueError:
-                worker.send(("reply", rid, (False, None, None)))
+                self._queue_send(worker, ("reply", rid, (False, None, None)))
         elif tag == "put_parts":
             # Client-shipped value: land it in the HEAD's store so any
             # worker can consume it (clients share no /dev/shm).
@@ -2795,12 +2903,12 @@ class Runtime:
                     out = mgr.list()
             except Exception as e:  # noqa: BLE001
                 out = e
-            worker.send(("reply", msg[1], out))
+            self._queue_send(worker, ("reply", msg[1], out))
         elif tag == "get_package":
             blob = getattr(self, "_pkg_cache", {}).get(msg[2])
-            worker.send(("reply", msg[1], blob))
+            self._queue_send(worker, ("reply", msg[1], blob))
         elif tag == "cluster_info":
-            worker.send(("reply", msg[1], {
+            self._queue_send(worker, ("reply", msg[1], {
                 "resources": self.cluster_resources(),
                 "available": self.available_resources(),
                 "nodes": self.list_nodes(),
@@ -3029,43 +3137,14 @@ class Runtime:
 
     def submit_task_from_worker(self, spec: dict, submitter=None):
         """Nested submission: worker-generated task, driver-owned objects."""
-        # The submitting worker's store created any by-value arg segments in
-        # tmp_segments; frees are routed back there (segment-pool reuse).
-        if submitter is not None and spec.get("tmp_segments"):
-            spec["_creator_worker"] = submitter
-        req = spec.get("resources") or {"CPU": 1.0}
-        rec = TaskRecord(spec, req, spec.get("max_retries",
-                                             self.config.default_max_retries))
-        _apply_strategy(rec, spec)
-        tid = TaskID(spec["task_id"])
-        with self.lock:
-            for i in range(spec["num_returns"]):
-                oid = tid.object_id(i)
-                st = self.objects.get(oid)
-                if st is None:
-                    st = self.objects[oid] = ObjectState(tid)
-                else:
-                    st.task_id = tid
-                # The submitting worker's refs are counted here (its
-                # ObjectRefs are built with _register=False); its __del__
-                # decrefs pair with this.
-                st.worker_refs += 1
-            if spec.get("func_payload") is not None:
-                fid = spec["func_id"]
-                self.functions.setdefault(fid, spec.pop("func_payload"))
-            self.tasks[spec["task_id"]] = rec
-            self.task_events.append(
-                {"task_id": spec["task_id"].hex(),
-                 "name": spec.get("name"),
-                 "state": "SUBMITTED", "time": time.time()})
-            self._register_lineage_locked(spec)
-            self._pin_nested_locked(spec.get("nested_refs", []))
-            self._resolve_deps_locked(rec)
-            if "actor_id" in spec:
-                self._enqueue_actor_task_locked(rec)
-            elif rec.deps_pending == 0:
-                self._enqueue_pending_locked(rec)
-                self._dispatch_locked()
+        self.submit_tasks_from_worker([spec], submitter=submitter)
+
+    def submit_tasks_from_worker(self, specs: List[dict], submitter=None):
+        """Bulk form of the nested-submission path (the wire carries it
+        as one ("submit_batch", [spec, ...]) message): every spec
+        registers under ONE lock acquisition, then one dispatch pass /
+        one pump per distinct actor covers the whole batch."""
+        self._submit_specs(specs, from_worker=True, submitter=submitter)
 
     def _on_worker_mget(self, worker: WorkerHandle, rid, id_bins, timeout):
         """Batched worker get: ONE reply listing (ok, descr) per id, sent
@@ -3094,7 +3173,7 @@ class Runtime:
                     st.shipped = True
                     out.append((st.status == READY, st.descr))
             try:
-                worker.send(("mgot", rid, out))
+                self._queue_send(worker, ("mgot", rid, out))
             except Exception:
                 # Requester died mid-wait: never let its broken conn abort
                 # the completing worker's result handling (this runs inside
@@ -3120,9 +3199,7 @@ class Runtime:
                          if not r.is_actor_creation]
             if stealable:
                 try:
-                    worker.queue_msg(("steal", 0, stealable))
-                    self._dirty_workers.add(worker)
-                    self._sender_event.set()
+                    self._queue_send(worker, ("steal", 0, stealable))
                 except Exception:
                     pass
             state["left"] = len(pend)
@@ -3203,12 +3280,50 @@ class Runtime:
                     and worker.lease_req is not None:
                 self._end_lease_locked(worker)
 
+    def _reroute_dead_worker_frees_locked(self, worker: WorkerHandle):
+        """A dead worker's buffered free_segment messages would vanish
+        with its conn: run the store-side fallback unlink instead (the
+        path the pre-conflation direct-send error handling took) so the
+        segments don't leak until session end."""
+        with worker.send_lock:
+            msgs = worker.outbuf + worker.outbox
+            worker.outbuf = []
+            worker.outbox = []
+        flat: List[tuple] = []
+        for m in msgs:
+            if protocol.is_batch(m):
+                flat.extend(m[1])
+            else:
+                flat.append(m)
+        agent = worker.node.agent if worker.node is not None else None
+        for m in flat:
+            if m[0] != "free_segment":
+                continue
+            name, size = m[1], m[2]
+            if agent is None:
+                try:
+                    self.shm.unlink(name, size, reusable=False)
+                except Exception:
+                    pass
+            elif not agent.dead:
+                try:
+                    agent.send(("unlink_segment", name, size))
+                except Exception:
+                    pass
+
     def _kill_worker_locked(self, worker: WorkerHandle):
         worker.dead = True
         self._conn_to_worker.pop(worker.conn, None)
         self._workers_by_hex.pop(worker.worker_id.hex(), None)
         worker.node.all_workers.pop(id(worker), None)
         self.worker_funcs.pop(id(worker), None)
+        # Ship anything still buffered (frees, steals) before the kill;
+        # whatever cannot be delivered gets its store-side fallback.
+        try:
+            worker.flush_buffered()
+        except Exception:
+            pass
+        self._reroute_dead_worker_frees_locked(worker)
         try:
             worker.send(("kill",))
         except Exception:
@@ -3221,12 +3336,18 @@ class Runtime:
     def _on_worker_death(self, worker: WorkerHandle):
         with self.lock:
             if worker.dead:
+                # A failed flush can re-buffer messages AFTER the first
+                # death pass drained them (reader-thread EOF and sender-
+                # thread send failure race): drain again so rerouted
+                # frees are never lost.  Idempotent.
+                self._reroute_dead_worker_frees_locked(worker)
                 return
             worker.dead = True
             self._conn_to_worker.pop(worker.conn, None)
             self._workers_by_hex.pop(worker.worker_id.hex(), None)
             worker.node.all_workers.pop(id(worker), None)
             self.worker_funcs.pop(id(worker), None)
+            self._reroute_dead_worker_frees_locked(worker)
             for key, lst in worker.node.idle_workers.items():
                 if worker in lst:
                     lst.remove(worker)
@@ -3524,7 +3645,8 @@ class Runtime:
                 # Drop the record from its scheduling-class queue now —
                 # dispatch stops at an unplaceable class head, so cancelled
                 # records behind it would otherwise be retained forever.
-                q = self.pending_tasks.get(self._sched_class(rec))
+                q = self.pending_tasks.get(rec.sched_key
+                                           or self._sched_class(rec))
                 if q is not None:
                     try:
                         q.remove(rec)
@@ -3549,9 +3671,8 @@ class Runtime:
                     # otherwise burn retries or die as WorkerCrashedError).
                     w.pending_force_kill = rec.spec["task_id"]
                     try:
-                        w.queue_msg(("steal", 0, list(w.inflight.keys())))
-                        self._dirty_workers.add(w)
-                        self._sender_event.set()
+                        self._queue_send(w, ("steal", 0,
+                                             list(w.inflight.keys())))
                     except Exception:
                         try:
                             w.proc.terminate()
@@ -3578,9 +3699,8 @@ class Runtime:
                 # and fails it.  Already-started tasks are uncancellable
                 # without force (reference semantics).
                 try:
-                    rec.worker.queue_msg(("steal", 0, [rec.spec["task_id"]]))
-                    self._dirty_workers.add(rec.worker)
-                    self._sender_event.set()
+                    self._queue_send(rec.worker,
+                                     ("steal", 0, [rec.spec["task_id"]]))
                 except Exception:
                     pass
 

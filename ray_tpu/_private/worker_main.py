@@ -204,12 +204,16 @@ class _WorkerRuntime:
     def _send(self, msg):
         head_bins = self._drain_decrefs()
         abuf = self._drain_actor_decrefs()
+        msgs = []
+        if head_bins:
+            msgs.append(("decref_batch", head_bins))
+        if abuf:
+            msgs.append(("actor_decref_batch", abuf))
+        msgs.append(msg)
+        # One ("batch", ...) pickle + one write for the whole burst —
+        # buffered ref drops ride the same syscall as the payload.
         with self.send_lock:
-            if head_bins:
-                protocol.send(self.conn, ("decref_batch", head_bins))
-            if abuf:
-                protocol.send(self.conn, ("actor_decref_batch", abuf))
-            protocol.send(self.conn, msg)
+            protocol.send_batch(self.conn, msgs)
 
     def send_result(self, entry):
         """Buffer one completed task's (task_id, ok, returns, meta);
@@ -225,6 +229,9 @@ class _WorkerRuntime:
             if not self._result_buf:
                 return
             buf, self._result_buf = self._result_buf, []
+        # _send coalesces the results with any buffered decref_batch /
+        # actor_decref_batch into ONE ("batch", ...) envelope: the reply
+        # burst for N short tasks is one pickle + one write.
         if len(buf) == 1:
             e = buf[0]
             self._send(("result", e[0], e[1], e[2], e[3]))
@@ -248,11 +255,13 @@ class _WorkerRuntime:
         abuf = self._drain_actor_decrefs()
         if not head_bins and not abuf:
             return
+        msgs = []
+        if head_bins:
+            msgs.append(("decref_batch", head_bins))
+        if abuf:
+            msgs.append(("actor_decref_batch", abuf))
         with self.send_lock:
-            if head_bins:
-                protocol.send(self.conn, ("decref_batch", head_bins))
-            if abuf:
-                protocol.send(self.conn, ("actor_decref_batch", abuf))
+            protocol.send_batch(self.conn, msgs)
 
     # Actor-handle refcounts (reference: actor out-of-scope GC) — the head
     # keeps the authoritative count; addref is sent inline (pickle-time,
@@ -627,6 +636,46 @@ class _WorkerRuntime:
         return [ObjectRef(tid.object_id(i), _register=False)
                 for i in range(spec["num_returns"])]
 
+    def submit_tasks(self, specs: list) -> list:
+        """Bulk fan-out submission from a worker/client: direct-eligible
+        specs register in the ownership table under one lock pass and
+        pump once per scheduling class (DirectCaller.submit_many);
+        head-bound plain specs ship as ONE ("submit_batch", ...) message
+        instead of n ("submit", ...) sends.  Actor specs keep the
+        per-channel FIFO path (ordering).  Returns one ref list per
+        spec, same as n submit_task calls."""
+        out = [None] * len(specs)
+        direct_specs = []
+        head_specs = []
+        for i, spec in enumerate(specs):
+            if "actor_id" in spec:
+                out[i] = self.submit_task(spec)
+                continue
+            tid = TaskID(spec["task_id"])
+            if spec.get("func_payload") is not None:
+                self._fn_payloads.setdefault(spec["func_id"],
+                                             spec["func_payload"])
+            out[i] = [ObjectRef(tid.object_id(j), _register=False)
+                      for j in range(spec["num_returns"])]
+            if self.direct.eligible(spec):
+                direct_specs.append(spec)
+            else:
+                head_specs.append(spec)
+        if direct_specs:
+            owned_nested = [
+                b for spec in direct_specs
+                for b in spec.get("nested_refs", ())
+                if self.direct.status_of(ObjectID(b))
+                not in (None, direct_mod.DELEGATED)]
+            if owned_nested:
+                self.direct.export_refs(owned_nested)
+            self.direct.submit_many(direct_specs)
+        if head_specs:
+            for spec in head_specs:
+                self._export_for_head_path(spec)
+            self._send(("submit_batch", head_specs))
+        return out
+
     def wait_objects(self, refs, num_returns, timeout, fetch_local):
         # Same blocked/unblocked envelope as get_objects: the lease's CPU
         # slot is released while this worker sits in ray.wait, so tasks
@@ -994,9 +1043,9 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
             with tq_cv:
                 tasks.append(msg)
                 tq_cv.notify()
-        elif tag == "msg_batch":
-            # Conflation-sender frame: a burst of buffered task-path
-            # messages in dispatch order.
+        elif tag == "batch" or tag == "msg_batch":
+            # Wire-batch envelope (or the legacy conflation-sender
+            # spelling): a burst of buffered messages in send order.
             for m in msg[1]:
                 handle(m)
         elif tag == "steal":

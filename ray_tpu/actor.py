@@ -58,6 +58,12 @@ class ActorMethod:
                         overrides.get("num_returns", self._num_returns))
         return m
 
+    def _build_spec(self, rt, args, kwargs):
+        """(spec, num_returns) for one call — the _bulk_submit hook."""
+        spec = self._handle._build_method_spec(
+            rt, self._name, args, kwargs, self._num_returns)
+        return spec, self._num_returns
+
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
             self._name, args, kwargs, self._num_returns)
@@ -95,8 +101,10 @@ class ActorHandle:
         raise AttributeError(
             f"Actor has no method {item!r}; remote methods: {sorted(meta)}")
 
-    def _submit_method(self, method_name, args, kwargs, num_returns):
-        rt = require_runtime()
+    def _build_method_spec(self, rt, method_name, args, kwargs,
+                           num_returns):
+        """Spec for one method call (shared by .remote and the bulk
+        submission helper, remote_function._bulk_submit)."""
         spec = {
             "task_id": new_task_id().binary(),
             "actor_id": self._actor_id,
@@ -106,6 +114,12 @@ class ActorHandle:
             "func_id": None,
         }
         serialize_args(rt, args, kwargs, spec)
+        return spec
+
+    def _submit_method(self, method_name, args, kwargs, num_returns):
+        rt = require_runtime()
+        spec = self._build_method_spec(rt, method_name, args, kwargs,
+                                       num_returns)
         refs = rt.submit_task(spec)
         if num_returns == 0:
             return None
@@ -172,6 +186,9 @@ class ActorClass:
         self._options = dict(options or {})
         self._payload: Optional[bytes] = None
         self._func_id: Optional[str] = None
+        # dir()-walk of the class is invariant: computed once, shared by
+        # clones (options() re-clones carry it over like _payload).
+        self._method_meta: Optional[Dict[str, int]] = None
         self.__name__ = getattr(cls, "__name__", "Actor")
 
     def __call__(self, *a, **kw):
@@ -185,6 +202,7 @@ class ActorClass:
         clone = ActorClass(self._cls, merged)
         clone._payload = self._payload
         clone._func_id = self._func_id
+        clone._method_meta = self._method_meta
         return clone
 
     def bind(self, *args, **kwargs):
@@ -214,7 +232,9 @@ class ActorClass:
                 diagnose_pickle_error(self._cls, self.__name__, err)
             self._func_id = "actor-" + hashlib.sha1(
                 self._payload).hexdigest()[:24]
-        method_meta = _collect_methods(self._cls)
+        if self._method_meta is None:
+            self._method_meta = _collect_methods(self._cls)
+        method_meta = self._method_meta
         resources = _normalize_resources(opts)
         spec = {
             "task_id": new_task_id().binary(),

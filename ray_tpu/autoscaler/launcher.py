@@ -271,8 +271,26 @@ class GCPTpuProvider(NodeProvider):
 
 # --------------------------------------------------------------- commands --
 def _state_path(name: str) -> str:
-    os.makedirs(STATE_DIR, exist_ok=True)
+    # The state dir holds cluster authkeys: owner-only, like ~/.ssh.
+    os.makedirs(STATE_DIR, mode=0o700, exist_ok=True)
+    try:
+        os.chmod(STATE_DIR, 0o700)  # pre-existing dir from an older run
+    except OSError:
+        pass
     return os.path.join(STATE_DIR, f"{name}.json")
+
+
+def _write_state(state_file: str, state: Dict[str, Any]) -> None:
+    """Write the cluster state file with mode 0600: it carries the
+    cluster authkey, which a world-readable file would hand to every
+    local user (the cluster trusts any dialer holding it)."""
+    fd = os.open(state_file, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
+        json.dump(state, f)
+    try:
+        os.chmod(state_file, 0o600)  # file may predate this hardening
+    except OSError:
+        pass
 
 
 def _load_config(path: str) -> Dict[str, Any]:
@@ -352,7 +370,7 @@ def up(config_path: str) -> Dict[str, Any]:
         "nodes": [], "config_path": os.path.abspath(config_path),
         "provider_type": ptype, "agent_pids": {},
     }
-    json.dump(state, open(state_file, "w", encoding="utf-8"))
+    _write_state(state_file, state)
     provider = _make_provider(cfg, address, authkey_hex)
     try:
         for node_type, spec in cfg["worker_types"].items():
@@ -365,7 +383,7 @@ def up(config_path: str) -> Dict[str, Any]:
             provider.pids() if isinstance(provider,
                                           SubprocessAgentProvider)
             else {})
-        json.dump(state, open(state_file, "w", encoding="utf-8"))
+        _write_state(state_file, state)
     print(f"cluster {name!r} up: {address} "
           f"(head pid {head_proc.pid}, "
           f"{len(state['nodes'])} worker node(s))")

@@ -33,6 +33,7 @@ from typing import Any, Callable, Iterator, List, Optional, Union
 import numpy as np
 
 import ray_tpu as ray
+from ray_tpu.remote_function import _bulk_submit
 
 
 # --------------------------------------------------------------- block ops
@@ -438,7 +439,8 @@ class Dataset:
         rows never pass through the driver (reference: repartition via
         shuffle/split_at_indices, not driver collect)."""
         blocks = self._executed_refs()
-        counts = ray.get([_count_block.remote(b) for b in blocks])
+        counts = ray.get(_bulk_submit([(_count_block, (b,), None)
+                                       for b in blocks]))
         total = sum(counts)
         num_blocks = max(1, num_blocks)
         bounds = [total * (i + 1) // num_blocks
@@ -465,7 +467,8 @@ class Dataset:
         blocks = self._executed_refs()
         if not equal:
             return [Dataset(blocks[i::n]) for i in builtins.range(n)]
-        counts = ray.get([_count_block.remote(b) for b in blocks])
+        counts = ray.get(_bulk_submit([(_count_block, (b,), None)
+                                       for b in blocks]))
         total = sum(counts)
         per = total // n
         bounds = [per * (i + 1) for i in builtins.range(n)]
@@ -535,11 +538,12 @@ class Dataset:
         dataset is re-sliced to this one's block row boundaries, then
         blocks pair off in per-block tasks."""
         blocks = self._executed_refs()
-        counts = ray.get([_count_block.remote(b) for b in blocks])
+        counts = ray.get(_bulk_submit([(_count_block, (b,), None)
+                                       for b in blocks]))
         bounds = list(itertools.accumulate(counts))
         other_blocks = other._executed_refs()
-        other_counts = ray.get([_count_block.remote(b)
-                                for b in other_blocks])
+        other_counts = ray.get(_bulk_submit([(_count_block, (b,), None)
+                                             for b in other_blocks]))
         if sum(counts) != sum(other_counts):
             raise ValueError(
                 f"zip requires equal row counts: {sum(counts)} vs "
@@ -812,7 +816,7 @@ def read_parquet(path: str, *, parallelism: int = 8) -> Dataset:
 
         return pq.read_table(f)  # arrow Table block, zero-copy downstream
 
-    return Dataset([_load.remote(f) for f in files])
+    return Dataset(_bulk_submit([(_load, (f,), None) for f in files]))
 
 
 def read_csv(path: str, *, parallelism: int = 8) -> Dataset:
@@ -828,7 +832,7 @@ def read_csv(path: str, *, parallelism: int = 8) -> Dataset:
 
         return pd.read_csv(f).to_dict("records")
 
-    return Dataset([_load.remote(f) for f in files])
+    return Dataset(_bulk_submit([(_load, (f,), None) for f in files]))
 
 
 def read_json(path: str, *, parallelism: int = 8) -> Dataset:
@@ -845,7 +849,7 @@ def read_json(path: str, *, parallelism: int = 8) -> Dataset:
         with open(f) as fh:
             return [json.loads(line) for line in fh if line.strip()]
 
-    return Dataset([_load.remote(f) for f in files])
+    return Dataset(_bulk_submit([(_load, (f,), None) for f in files]))
 
 
 def read_text(path: str, *, parallelism: int = 8) -> Dataset:
@@ -861,4 +865,4 @@ def read_text(path: str, *, parallelism: int = 8) -> Dataset:
         with open(f) as fh:
             return [line.rstrip("\n") for line in fh]
 
-    return Dataset([_load.remote(f) for f in files])
+    return Dataset(_bulk_submit([(_load, (f,), None) for f in files]))

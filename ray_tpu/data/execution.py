@@ -20,6 +20,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import ray_tpu as ray
+from ray_tpu.remote_function import _bulk_submit
 
 ACTOR_OP = "map_batches_actor"
 
@@ -134,7 +135,8 @@ class ActorPoolMapOperator:
             _MapWorker.options(num_cpus=1).remote(fn, batch_format)
             for _ in range(max(1, size))]
         self._inflight = [0] * len(self._actors)
-        self._ready_refs = [a.ready.remote() for a in self._actors]
+        self._ready_refs = _bulk_submit([(a.ready, (), None)
+                                         for a in self._actors])
         self._ready = [False] * len(self._actors)
         # Unscheduled actors get this long to come up while the ready
         # ones are busy; after that, dispatch permanently ignores them.

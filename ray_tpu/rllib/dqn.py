@@ -19,6 +19,7 @@ import numpy as np
 import optax
 
 import ray_tpu as ray
+from ray_tpu.remote_function import _bulk_submit
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env import VectorEnv
 from ray_tpu.rllib.replay_buffers import (
@@ -217,7 +218,8 @@ class DQN(Algorithm):
 
     def _sync_worker_weights(self):
         w = jax.device_get(self.params)
-        ray.get([wk.set_weights.remote(w) for wk in self.workers])
+        ray.get(_bulk_submit([(wk.set_weights, (w,), None)
+                              for wk in self.workers]))
 
     def _epsilon(self) -> float:
         cfg: DQNConfig = self.algo_config

@@ -156,6 +156,7 @@ class PPO(Algorithm):
         for pid, batch in ma_batch.items():
             if not len(batch):
                 continue
+            pm: Dict[str, Any] = {}  # num_sgd_iter=0 must not NameError
             for _ in range(cfg.num_sgd_iter):
                 shuffled = batch.shuffle(self._rng)
                 mb_size = min(cfg.sgd_minibatch_size, len(shuffled))

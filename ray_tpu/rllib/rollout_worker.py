@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu as ray
+from ray_tpu.remote_function import _bulk_submit
 from ray_tpu.rllib.models import ActorCriticMLP, sample_action
 from ray_tpu.rllib.sample_batch import (
     ACTIONS, DONES, LOGP, NEXT_OBS, OBS, REWARDS, SampleBatch, VF_PREDS,
@@ -165,7 +166,8 @@ class WorkerSet:
         return self._workers[idx]
 
     def sync_weights(self, weights):
-        ray.get([w.set_weights.remote(weights) for w in self._workers])
+        ray.get(_bulk_submit([(w.set_weights, (weights,), None)
+                              for w in self._workers]))
 
     def sample_sync(self, steps_per_worker: int):
         """synchronous_parallel_sample (reference:

@@ -19,6 +19,7 @@ import numpy as np
 import optax
 
 import ray_tpu as ray
+from ray_tpu.remote_function import _bulk_submit
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env import VectorEnv
 from ray_tpu.rllib.replay_buffers import BATCH_INDEXES, ReplayActor
@@ -296,7 +297,8 @@ class SAC(Algorithm):
 
     def _sync_worker_weights(self):
         w = jax.device_get(self.pi_params)
-        ray.get([wk.set_weights.remote(w) for wk in self.workers])
+        ray.get(_bulk_submit([(wk.set_weights, (w,), None)
+                              for wk in self.workers]))
 
     def training_step(self) -> Dict[str, Any]:
         cfg: SACConfig = self.algo_config

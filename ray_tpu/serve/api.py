@@ -10,9 +10,11 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu as ray
+from ray_tpu.remote_function import _bulk_submit
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
@@ -52,6 +54,7 @@ class ServeController:
     one per tick (rolling update)."""
 
     RECONCILE_PERIOD_S = 1.0
+    METRIC_LOOK_BACK_S = 3.0
 
     def __init__(self):
         self._deployments: Dict[str, Dict[str, Any]] = {}
@@ -62,8 +65,13 @@ class ServeController:
         # proxy's route table long-polled from the controller,
         # _private/http_proxy.py + long_poll.py ROUTE_TABLE key).
         self._routes: Dict[str, str] = {}
-        # autoscaling inputs: (name, handle_id) -> (ongoing, monotonic ts)
-        self._handle_metrics: Dict[tuple, tuple] = {}
+        # autoscaling inputs: (name, handle_id) -> recent (ongoing, ts)
+        # samples.  A short look-back window, not just the last sample:
+        # instantaneous queue depth oscillates with sampling phase (scale
+        # up -> queue drains faster -> next sample reads low -> scale
+        # back down), so decisions smooth over METRIC_LOOK_BACK_S
+        # (reference: look_back_period_s in autoscaling_policy.py).
+        self._handle_metrics: Dict[tuple, deque] = {}
         self._last_scale_up: Dict[str, float] = {}
         # Retired replicas draining before the actual kill: handles stop
         # routing to them immediately (they leave get_replicas), but the
@@ -154,9 +162,13 @@ class ServeController:
         """Handles report their in-flight request count — the autoscaling
         signal (reference: handle-side metrics pushed to the controller,
         _private/router.py + autoscaling_policy.py)."""
+        now = time.monotonic()
         with self._lock:
-            self._handle_metrics[(name, handle_id)] = (
-                ongoing, time.monotonic())
+            q = self._handle_metrics.get((name, handle_id))
+            if q is None:
+                q = self._handle_metrics[(name, handle_id)] = \
+                    deque(maxlen=32)
+            q.append((ongoing, now))
         return True
 
     def _spawn(self, d: Dict[str, Any], version: int):
@@ -179,9 +191,18 @@ class ServeController:
             return d.get("num_replicas", 1)
         now = time.monotonic()
         with self._lock:
-            ongoing = sum(v for (n, _h), (v, ts)
-                          in self._handle_metrics.items()
-                          if n == name and now - ts < 10.0)
+            # Per handle: the PEAK ongoing inside the look-back window —
+            # robust to sampling phase while load is sustained; an idle
+            # handle's samples age out and read 0 (downscale_delay then
+            # gates the shrink).
+            ongoing = 0
+            for (n, _h), samples in self._handle_metrics.items():
+                if n != name:
+                    continue
+                fresh = [v for v, ts in samples
+                         if now - ts < self.METRIC_LOOK_BACK_S]
+                if fresh:
+                    ongoing += max(fresh)
         target_per = max(cfg.get("target_ongoing_requests", 1), 1e-9)
         import math
 
@@ -745,7 +766,7 @@ def start(proxy_location: str = "HeadOnly", http_options: Optional[
             scheduling_strategy=NodeAffinitySchedulingStrategy(
                 n["node_id"], soft=False)).remote(host, port)
         proxies.append(p)
-    urls = ray.get([p.url.remote() for p in proxies])
+    urls = ray.get(_bulk_submit([(p.url, (), None) for p in proxies]))
     _state["node_proxies"] = proxies
     return urls
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu as ray
+from ray_tpu.remote_function import _bulk_submit
 from ray_tpu.util.placement_group import PlacementGroup
 
 
@@ -83,7 +84,9 @@ class WorkerGroup:
         return ray.get(self.execute_async(fn, *args, **kwargs))
 
     def execute_async(self, fn: Callable, *args, **kwargs):
-        return [w.execute.remote(fn, *args, **kwargs) for w in self._workers]
+        # Bulk path: one runtime submission for the whole worker group.
+        return _bulk_submit([(w.execute, (fn,) + args, kwargs)
+                             for w in self._workers])
 
     def execute_single(self, index: int, fn: Callable, *args, **kwargs):
         return ray.get(self._workers[index].execute.remote(fn, *args,
