@@ -103,6 +103,12 @@ def client_connect(address: str, authkey: bytes,
     os.environ.setdefault("RAY_TPU_AUTHKEY", authkey.hex())
     shm = ShmStore(shm_dir=tempfile.mkdtemp(prefix="ray_tpu_client_"))
     rt = ClientRuntime(conn, threading.Lock(), shm, max_inline)
+    # The puller dials remote object servers (including the head's own —
+    # large results stream back directly instead of relaying through the
+    # control-plane connection).  Hand it THIS cluster's authkey
+    # explicitly: the env setdefault above must not leave a stale key
+    # from an earlier session on the pull path.
+    rt._puller._authkey = authkey
     protocol.send(conn, ("client_ready", os.urandom(16).hex()))
     msg = protocol.recv(conn)
     assert msg[0] == "client_ack", msg

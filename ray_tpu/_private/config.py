@@ -51,6 +51,24 @@ class Config:
     # pooled pages take writes at memcpy speed).  0 disables pooling.
     shm_pool_bytes: int = 1 << 30
 
+    # --- Cross-node object transfer (the data-plane fast path;
+    # reference: object_manager.h:206 chunked push/pull with multiple
+    # transfers in flight, object_buffer_pool.h). ---
+    # Connections kept per peer object server: concurrent fetches of
+    # different segments ride separate pooled connections, and one large
+    # segment stripes across them.
+    object_pool_size: int = 4
+    # Segments at least this big are fetched as concurrent byte-range
+    # stripes of this length over multiple pooled connections (needs the
+    # peer's "fetch_range" capability).  0 disables striping.
+    object_stripe_threshold: int = 32 * 1024 * 1024
+    # Host the HEAD advertises for its object server when binding
+    # 0.0.0.0 (the hostname lookup fallback can resolve to 127.0.1.1 or
+    # a NAT-internal address on some distros; node agents have the same
+    # escape hatch via RAY_TPU_AGENT_ADVERTISE_HOST).  "" = derive from
+    # listen_host.
+    object_advertise_host: str = ""
+
     # Seconds a worker may sit idle before the pool reaps it (reference:
     # idle worker killing in worker_pool.cc).
     idle_worker_timeout_s: float = 300.0

@@ -27,6 +27,14 @@ Fallbacks: anything the direct path does not cover (placement groups,
 runtime_env, TPU resources, non-owned ref args, lease starvation) routes
 through the existing head path, with owned return refs *delegated* to the
 head so both paths share one lifetime story.
+
+Data plane: the direct path never moves payload bytes itself.  Results
+and big args travel as SHM *location* descriptors (name, size, store);
+a consumer on another node resolves the store's object-server address
+through the head once (``store_addr`` — address + verb caps) and pulls
+the segment over pooled, striped connections straight into local shm
+(object_transfer.py).  The head-relayed ``getparts`` path stays as the
+fallback for consumers without direct reachability.
 """
 
 from __future__ import annotations

@@ -36,6 +36,18 @@ from ray_tpu._private import object_transfer, protocol
 from ray_tpu._private.shm_store import ShmStore
 
 
+class _AgentStoreProxy:
+    """Attach-only store view that always resolves the agent's CURRENT
+    store — it is re-created with the session id after the head's ack,
+    and the object server may accept consumers on both sides of that."""
+
+    def __init__(self, agent: "NodeAgent"):
+        self._agent = agent
+
+    def attach(self, name: str):
+        return self._agent.store.attach(name)
+
+
 class NodeAgent:
     def __init__(self, head_address: str, authkey: bytes,
                  resources: Dict[str, float], shm_dir: str,
@@ -164,6 +176,11 @@ class NodeAgent:
             "store_id": self.store_id,
             "shm_dir": self.shm_dir,
             "object_addr": self.object_addr,
+            # Advertised object-server verbs beyond the original
+            # "fetch" — consumers only send e.g. "fetch_range" (striped
+            # pulls) to peers that declare it, so an old agent that
+            # would silently ignore the verb is never probed with it.
+            "object_caps": list(object_transfer.CAPS),
             "pid": os.getpid(),
             "hostname": os.uname().nodename,
         }))
@@ -181,18 +198,10 @@ class NodeAgent:
         self.store = ShmStore(shm_dir=self.shm_dir, session_id=self.session)
 
     def _object_server(self):
-        while not self._stopped:
-            try:
-                conn = self._obj_listener.accept()
-                protocol.enable_nodelay(conn)
-            except Exception:
-                if self._stopped:
-                    return
-                continue
-            threading.Thread(
-                target=object_transfer.serve_connection,
-                args=(conn, self.store), daemon=True,
-                name="agent-objconn").start()
+        object_transfer.accept_loop(self._obj_listener,
+                                    _AgentStoreProxy(self),
+                                    lambda: self._stopped,
+                                    "agent-objconn")
 
     def serve(self):
         while not self._stopped:

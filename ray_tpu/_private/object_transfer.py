@@ -1,32 +1,58 @@
-"""Direct node-to-node object transfer, chunked.
+"""Direct node-to-node object transfer, chunked, pooled and striped.
 
 Reference: ``src/ray/object_manager/object_manager.h:117,206`` +
 ``object_buffer_pool.h`` — objects move between nodes in bounded chunks
-directly between the object managers; the control plane (GCS) only brokers
-*locations*.  Here every node agent runs an object server on its own TCP
-listener; consumers (workers on other nodes, or the driver) dial it and
-pull the segment as a stream of ≤1 MB chunks.  The head carries location
+directly between the object managers, with MULTIPLE transfers in flight;
+the control plane (GCS) only brokers *locations*.  Here every node agent
+(and the head, for its own store) runs an object server on its own TCP
+listener; consumers (workers on other nodes, the driver, clients) dial it
+and pull segments as streams of ≤1 MB chunks.  The head carries location
 lookups only — never payload bytes.
 
-Flow control: one segment streams per connection at a time in CHUNK-sized
-sends; the receiver reads with ``recv_bytes_into`` straight into the
-destination buffer (one copy end-to-end), and TCP's window bounds the
-bytes in flight (the reference's in-flight chunk cap).
+Parallelism (the reference's in-flight chunk window,
+``object_buffer_pool.h``): the puller keeps a small CONNECTION POOL per
+peer store (``config.object_pool_size``, default 4).  Concurrent fetches
+of different segments from one peer each ride their own pooled
+connection, and a single large segment (≥ ``config.
+object_stripe_threshold``, default 32 MB) is fetched as concurrent
+byte-range STRIPES over several connections via the ``fetch_range`` verb.
+Peers that only speak the original ``fetch`` verb (no ``fetch_range`` in
+their advertised caps) are served by plain whole-segment streams — the
+pool still parallelizes across segments.
+
+Zero-copy receive: the receiver reserves its destination buffer up front
+(a shm mapping via ``ShmStore.reserve_recv`` — see ``pull_to_segment``)
+and ``recv_bytes_into``\\ s every chunk straight into it at its final
+offset.  Receive is one copy end-to-end, like the send side (which
+streams ``memoryview`` slices of the source mmap).
 """
 
 from __future__ import annotations
 
-import struct
+import logging
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private import protocol, serialization
 from ray_tpu._private.shm_store import _HEADER, _MAGIC
 
+logger = logging.getLogger(__name__)
+
 CHUNK = 1 << 20  # 1 MB, the reference's object-manager chunk size
 
+# Verbs this side's object server speaks beyond the original "fetch".
+# Advertised out of band (agent_ready info / store_addr replies) so pullers
+# never probe a peer with a verb it would silently ignore.
+CAPS: Tuple[str, ...] = ("fetch_range",)
 
-def _true_extent(view: memoryview) -> int:
+# Segment names whose metadata table failed to parse in _true_extent —
+# each is logged once at debug level (bounded; see below).
+_extent_fallbacks: set = set()
+
+
+def _true_extent(view: memoryview, name: str = "?") -> int:
     """Bytes actually used by the segment — pooled reuse can leave a file
     up to ~2x the object (plus stale freed-object bytes); shipping the
     slack would waste network and receiver memory."""
@@ -38,13 +64,24 @@ def _true_extent(view: memoryview) -> int:
         for o, n in zip(offsets, lengths):
             end = max(end, o + n)
         return min(end, len(view))
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — fall back to whole-file extent
+        # The fallback ships every byte of the file (incl. pool slack);
+        # log once per segment so the wasted bytes are diagnosable.
+        if name not in _extent_fallbacks:
+            if len(_extent_fallbacks) > 4096:
+                _extent_fallbacks.clear()
+            _extent_fallbacks.add(name)
+            logger.debug(
+                "object_transfer: cannot parse segment table of %s "
+                "(%r); shipping full file extent of %d bytes",
+                name, e, len(view))
         return len(view)
 
 
 def serve_connection(conn, store):
     """Agent-side loop for one consumer connection: stream requested
-    segments chunk by chunk (reference: ObjectManager::Push)."""
+    segments (or byte ranges of them) chunk by chunk (reference:
+    ObjectManager::Push)."""
     try:
         while True:
             msg = protocol.recv(conn)
@@ -57,10 +94,32 @@ def serve_connection(conn, store):
                     continue
                 try:
                     mv = memoryview(seg._mm)
-                    total = _true_extent(mv)
+                    total = _true_extent(mv, name)
                     protocol.send(conn, ("ok", total))
                     for off in range(0, total, CHUNK):
                         conn.send_bytes(mv[off:min(off + CHUNK, total)])
+                finally:
+                    del mv
+                    seg.close()
+            elif msg[0] == "fetch_range":
+                # Byte-range stripe (clamped to the true extent).  The
+                # reply carries BOTH the clamped stripe length and the
+                # segment's total extent, so the first stripe doubles as
+                # the size probe — no extra stat round trip.
+                _tag, name, off, length = msg
+                try:
+                    seg = store.attach(name)
+                except Exception as e:  # noqa: BLE001
+                    protocol.send(conn, ("err", repr(e)))
+                    continue
+                try:
+                    mv = memoryview(seg._mm)
+                    total = _true_extent(mv, name)
+                    off = min(max(0, off), total)
+                    n = max(0, min(length, total - off))
+                    protocol.send(conn, ("ok", n, total))
+                    for o in range(off, off + n, CHUNK):
+                        conn.send_bytes(mv[o:min(o + CHUNK, off + n)])
                 finally:
                     del mv
                     seg.close()
@@ -75,84 +134,115 @@ def serve_connection(conn, store):
             pass
 
 
-class ObjectPuller:
-    """Consumer-side client: cached connections to home-store object
-    servers, pulling segments as chunk streams (reference:
-    ObjectManager::Pull + ObjectBufferPool chunk assembly).
+def accept_loop(listener, store, stopped, conn_name: str):
+    """Shared object-server accept loop (node agents and the head run the
+    identical one): accept, disable Nagle, and hand each consumer
+    connection to its own ``serve_connection`` thread.  ``stopped`` is a
+    callable polled so the owner's shutdown (which closes the listener)
+    ends the loop."""
+    while not stopped():
+        try:
+            conn = listener.accept()
+            protocol.enable_nodelay(conn)
+        except Exception:
+            if stopped():
+                return
+            continue
+        threading.Thread(target=serve_connection, args=(conn, store),
+                         daemon=True, name=conn_name).start()
 
-    LOCK ORDER (checked by tests/test_lockcheck.py via devtools.lockcheck):
-    the registry ``_lock`` and the per-connection locks are INDEPENDENT
-    LEAVES — neither may be acquired while the other is held.  The
-    registry lock guards only the ``_conns`` dict (lookup/insert/pop,
-    never I/O under it); a per-connection lock is held across an entire
-    fetch stream (seconds of I/O), so taking ``_lock`` inside it would
-    stall every other connection's lookup, and taking a connection lock
-    inside ``_lock`` inverts that order.  Note ``fetch``'s error path:
-    ``drop`` (registry lock) runs only AFTER the ``with lock`` block has
-    released the connection lock.
+
+class _ConnPool:
+    """Connections to ONE peer object server.
+
+    The condition's lock guards only ``idle``/``total``/``closed`` —
+    it is NEVER held across a dial or any stream I/O, so a connection
+    mid-transfer cannot stall another thread's acquire/release.
+
+    Failure isolation: ``evict`` closes ONLY the broken connection and
+    decrements ``total`` under the condition, waking any waiter so it can
+    dial a replacement — other pooled connections (and the threads
+    streaming on them) are untouched.
     """
 
-    def __init__(self, authkey: bytes):
-        self._authkey = authkey
-        self._conns: Dict[str, tuple] = {}  # store_id -> (conn, lock)
-        self._lock = threading.Lock()
+    __slots__ = ("addr", "authkey", "limit", "idle", "total", "cv",
+                 "closed")
 
-    def _conn_for(self, store_id: str, addr: str):
-        with self._lock:
-            ent = self._conns.get(store_id)
-        if ent is not None:
-            return ent
-        from multiprocessing.connection import Client
+    def __init__(self, addr: str, authkey: bytes, limit: int):
+        self.addr = addr
+        self.authkey = authkey
+        self.limit = max(1, limit)
+        self.idle: list = []
+        self.total = 0
+        self.cv = threading.Condition()
+        self.closed = False
 
-        conn = Client(protocol.parse_address(addr), authkey=self._authkey)
-        protocol.enable_nodelay(conn)
-        ent = (conn, threading.Lock())
-        with self._lock:
-            # A racing dialer may have won; keep one, close the other.
-            cur = self._conns.setdefault(store_id, ent)
-            if cur is not ent:
-                try:
-                    conn.close()
-                except Exception:
-                    pass
-            return cur
+    def acquire(self, timeout: Optional[float] = None):
+        """An exclusive connection: a pooled idle one, a fresh dial while
+        under the limit, else wait for a release/evict.  Returns None on
+        timeout (stripe helpers give up and let the primary connection
+        finish the job)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self.cv:
+            while True:
+                if self.closed:
+                    raise OSError(f"connection pool to {self.addr} closed")
+                if self.idle:
+                    return self.idle.pop()
+                if self.total < self.limit:
+                    self.total += 1
+                    break  # dial outside the condition
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    return None
+                self.cv.wait(left)
+        try:
+            from multiprocessing.connection import Client
 
-    def drop(self, store_id: str):
-        with self._lock:
-            ent = self._conns.pop(store_id, None)
-        if ent is not None:
+            conn = Client(protocol.parse_address(self.addr),
+                          authkey=self.authkey)
+            protocol.enable_nodelay(conn)
+            return conn
+        except BaseException:
+            with self.cv:
+                self.total -= 1
+                self.cv.notify()
+            raise
+
+    def release(self, conn):
+        close_it = False
+        with self.cv:
+            if self.closed:
+                self.total -= 1
+                close_it = True
+            else:
+                self.idle.append(conn)
+            self.cv.notify()
+        if close_it:
             try:
-                ent[0].close()
+                conn.close()
             except Exception:
                 pass
 
-    def fetch(self, store_id: str, addr: str, name: str) -> bytearray:
-        """The raw segment bytes, pulled in CHUNK pieces."""
-        conn, lock = self._conn_for(store_id, addr)
+    def evict(self, conn):
+        """Close ONLY this (broken) connection; waiters redial."""
         try:
-            with lock:
-                protocol.send(conn, ("fetch", name))
-                tag, val = protocol.recv(conn)
-                if tag != "ok":
-                    from ray_tpu import exceptions as exc
-
-                    raise exc.ObjectLostError(
-                        f"segment {name} unreadable at {store_id}: {val}")
-                total = val
-                buf = bytearray(total)
-                view = memoryview(buf)
-                off = 0
-                while off < total:
-                    off += conn.recv_bytes_into(view, off)
-                return buf
-        except (EOFError, OSError, TypeError, struct.error):
-            self.drop(store_id)
-            raise
+            conn.close()
+        except Exception:
+            pass
+        with self.cv:
+            self.total -= 1
+            self.cv.notify()
 
     def close(self):
-        with self._lock:
-            conns, self._conns = list(self._conns.values()), {}
-        for conn, _ in conns:
+        with self.cv:
+            self.closed = True
+            conns, self.idle = self.idle, []
+            self.total -= len(conns)
+            self.cv.notify_all()
+        for conn in conns:
             try:
                 protocol.send(conn, ("close",))
             except Exception:
@@ -161,6 +251,227 @@ class ObjectPuller:
                 conn.close()
             except Exception:
                 pass
+
+
+class ObjectPuller:
+    """Consumer-side client: pooled connections to home-store object
+    servers, pulling segments as chunk streams — whole segments or
+    concurrent byte-range stripes (reference: ObjectManager::Pull +
+    ObjectBufferPool chunk assembly with multiple chunks in flight).
+
+    LOCK ORDER (checked by tests/test_lockcheck.py via devtools.lockcheck):
+    the registry ``_lock`` and every pool's condition lock are INDEPENDENT
+    LEAVES — neither may be acquired while the other is held.  The
+    registry lock guards only the ``_pools`` dict (lookup/insert/pop,
+    never I/O and never a pool-condition acquire under it); a pool's
+    condition guards only that pool's idle list and connection count and
+    is never held across a dial or any stream I/O.  Streaming itself runs
+    on an exclusively-acquired connection and holds NO lock at all — this
+    is what lets N transfers from one peer proceed in parallel where the
+    old design serialized them behind one per-connection lock held for
+    the whole stream.
+    """
+
+    def __init__(self, authkey: bytes, pool_size: Optional[int] = None,
+                 stripe_threshold: Optional[int] = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+        self._authkey = authkey
+        self._pool_size = (pool_size if pool_size is not None
+                           else _cfg.object_pool_size)
+        self._stripe = (stripe_threshold if stripe_threshold is not None
+                        else _cfg.object_stripe_threshold)
+        self._pools: Dict[str, _ConnPool] = {}  # store_id -> pool
+        self._lock = threading.Lock()
+
+    def _pool_for(self, store_id: str, addr: str) -> _ConnPool:
+        stale = None
+        with self._lock:
+            pool = self._pools.get(store_id)
+            if pool is not None and pool.addr != addr:
+                # Peer restarted on a new port: retire the old pool.
+                stale, pool = pool, None
+            if pool is None:
+                pool = self._pools[store_id] = _ConnPool(
+                    addr, self._authkey, self._pool_size)
+        if stale is not None:
+            stale.close()
+        return pool
+
+    def drop(self, store_id: str):
+        with self._lock:
+            pool = self._pools.pop(store_id, None)
+        if pool is not None:
+            pool.close()
+
+    # ------------------------------------------------------------ fetch --
+    def fetch(self, store_id: str, addr: str, name: str, sink=None,
+              caps: Tuple[str, ...] = ()):
+        """The raw segment bytes, pulled in CHUNK pieces.
+
+        ``sink(total)`` supplies the destination buffer once the size is
+        known (default: a fresh ``bytearray``) — pass a shm mapping for a
+        one-copy receive (``pull_to_segment``).  ``caps`` is the peer's
+        advertised verb set: with ``"fetch_range"`` present, a segment at
+        least the stripe threshold long arrives as concurrent byte-range
+        stripes over several pooled connections.  Returns the filled
+        buffer."""
+        pool = self._pool_for(store_id, addr)
+        conn = pool.acquire()
+        try:
+            if "fetch_range" in caps and self._stripe > 0:
+                buf = self._fetch_striped(pool, conn, store_id, name, sink)
+            else:
+                buf = self._fetch_whole(conn, store_id, name, sink)
+        except BaseException:
+            # Evict ONLY this connection (a peer error reply leaves the
+            # stream positioned at the next request, but a transport or
+            # mid-stream failure leaves it desynced — close it either
+            # way; redial is cheap and rare).  Concurrent fetches on the
+            # pool's other connections are unaffected.
+            pool.evict(conn)
+            raise
+        pool.release(conn)
+        return buf
+
+    def _fetch_whole(self, conn, store_id: str, name: str, sink):
+        protocol.send(conn, ("fetch", name))
+        reply = protocol.recv(conn)
+        if reply[0] != "ok":
+            from ray_tpu import exceptions as exc
+
+            raise exc.ObjectLostError(
+                f"segment {name} unreadable at {store_id}: {reply[1]}")
+        total = reply[1]
+        buf = bytearray(total) if sink is None else sink(total)
+        view = memoryview(buf)
+        _recv_range(conn, view, 0, total)
+        return buf
+
+    def _fetch_striped(self, pool: _ConnPool, conn, store_id: str,
+                      name: str, sink):
+        """Whole segment via byte-range requests: the first request is
+        both size probe and first stripe; anything beyond it is split
+        into stripe-sized ranges drained by this thread AND helper
+        threads on additional pooled connections."""
+        from ray_tpu import exceptions as exc
+
+        stripe = self._stripe
+        protocol.send(conn, ("fetch_range", name, 0, stripe))
+        reply = protocol.recv(conn)
+        if reply[0] != "ok":
+            raise exc.ObjectLostError(
+                f"segment {name} unreadable at {store_id}: {reply[1]}")
+        _tag, first_n, total = reply
+        buf = bytearray(total) if sink is None else sink(total)
+        view = memoryview(buf)
+        _recv_range(conn, view, 0, first_n)
+        if first_n >= total:
+            return buf
+
+        ranges = deque((off, min(stripe, total - off))
+                       for off in range(first_n, total, stripe))
+        errors: list = []
+
+        def drain(c):
+            while not errors:
+                try:
+                    off, length = ranges.popleft()
+                except IndexError:
+                    return
+                protocol.send(c, ("fetch_range", name, off, length))
+                r = protocol.recv(c)
+                if r[0] != "ok" or r[1] != length:
+                    raise exc.ObjectLostError(
+                        f"segment {name} changed mid-stripe at "
+                        f"{store_id}: {r!r}")
+                _recv_range(c, view, off, length)
+
+        def helper():
+            # A busy pool is not an error: give up quickly and let the
+            # primary connection finish the remaining ranges.
+            try:
+                c = pool.acquire(timeout=0.25)
+            except OSError:
+                return
+            if c is None:
+                return
+            try:
+                drain(c)
+            except BaseException as e:  # noqa: BLE001 — joined below
+                errors.append(e)
+                pool.evict(c)
+                return
+            pool.release(c)
+
+        helpers = [
+            threading.Thread(target=helper, daemon=True,
+                             name="rtpu-stripe")
+            for _ in range(min(len(ranges), self._pool_size - 1))
+        ]
+        for t in helpers:
+            t.start()
+        try:
+            drain(conn)
+        finally:
+            for t in helpers:
+                t.join()
+        if errors:
+            raise errors[0]
+        return buf
+
+    def close(self):
+        with self._lock:
+            pools, self._pools = list(self._pools.values()), {}
+        for pool in pools:
+            pool.close()
+
+
+def _recv_range(conn, view: memoryview, off: int, n: int):
+    """Receive exactly ``n`` chunk messages' worth of bytes straight into
+    ``view`` at ``off`` (one copy: socket -> destination buffer)."""
+    got = 0
+    while got < n:
+        got += conn.recv_bytes_into(view, off + got)
+    if got != n:
+        raise OSError(
+            f"object stream desync: got {got} bytes for a {n}-byte range")
+
+
+def pull_to_segment(puller: ObjectPuller, store, store_id: str, addr: str,
+                    name: str, caps: Tuple[str, ...] = ()):
+    """Pull ``name`` from a remote object server straight into a local shm
+    mapping and return it as a read ``Segment`` — the one-copy receive
+    path (socket -> mmap; deserialization then builds zero-copy views over
+    the mapping).  Uses ``ShmStore.reserve_recv``/``commit_recv``; the
+    reservation is aborted on any failure.  When the store cannot host the
+    reservation (capacity gate, tmpfs full), the receive degrades to a
+    heap buffer — the transfer still completes one-copy, it just doesn't
+    live in shm."""
+    from ray_tpu._private.shm_store import Segment
+
+    state: dict = {}
+
+    def sink(total: int):
+        state["total"] = total
+        try:
+            buf = store.reserve_recv(name, total)
+            state["reserved"] = True
+        except (MemoryError, ValueError, OSError):
+            buf = bytearray(total)
+            state["reserved"] = False
+        state["buf"] = buf
+        return buf
+
+    try:
+        puller.fetch(store_id, addr, name, sink=sink, caps=caps)
+    except BaseException:
+        if state.get("reserved"):
+            store.abort_recv(state["buf"])
+        raise
+    if state.get("reserved"):
+        return store.commit_recv(name, state["buf"], state["total"])
+    return Segment(name, "", state["total"], state["buf"])
 
 
 def parse_segment_bytes(buf) -> Tuple[bytes, List[memoryview]]:

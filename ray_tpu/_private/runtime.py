@@ -425,7 +425,9 @@ class Runtime:
         # Direct chunked pulls from remote object servers (reference:
         # ObjectManager::Pull); the head-relay path remains as fallback
         # and counts its uses (tests assert it stays cold).
-        self._puller = object_transfer.ObjectPuller(b"")  # authkey set below
+        self._puller = object_transfer.ObjectPuller(  # authkey set below
+            b"", pool_size=config.object_pool_size,
+            stripe_threshold=config.object_stripe_threshold)
         self.relayed_segments = 0   # head-relayed agent reads (fallback)
         self.brokered_parts = 0     # worker getparts served via the head
         # Identity of this process's object store: SHM descriptors carry it
@@ -465,6 +467,25 @@ class Runtime:
             target=self._accept_loop, args=(self._tcp_listener,),
             daemon=True, name="ray_tpu-accept-tcp")
         self._tcp_accept_thread.start()
+        # HEAD OBJECT SERVER: direct chunked pulls from the head node's
+        # own store (driver puts, head-local worker results).  Keeps the
+        # head's control-plane connections out of the payload path — a
+        # remote consumer of a head-homed segment dials here instead of
+        # round-tripping a multi-hundred-MB getparts reply through the
+        # worker-message handler (reference: every node's object manager
+        # has a transfer port, object_manager.h:117 — the head included).
+        self._obj_listener = multiprocessing.connection.Listener(
+            (config.listen_host, 0), "AF_INET", backlog=64,
+            authkey=self._authkey)
+        obj_adv = config.object_advertise_host or config.listen_host
+        if obj_adv == "0.0.0.0":
+            import socket as _socket
+
+            obj_adv = _socket.gethostbyname(_socket.gethostname())
+        self.object_addr = protocol.format_address(
+            (obj_adv, self._obj_listener.address[1]))
+        threading.Thread(target=self._object_server_loop, daemon=True,
+                         name="ray_tpu-objsrv").start()
 
         head_resources = {"CPU": float(num_cpus if num_cpus is not None
                                        else os.cpu_count() or 1)}
@@ -1152,11 +1173,17 @@ class Runtime:
                 f"{descr[1]} unrecoverable")
         addr = agent.info.get("object_addr")
         if addr:
-            # Direct chunked pull from the home node's object server —
-            # the head never touches the payload (object_manager.h:206).
+            # Direct chunked pull from the home node's object server,
+            # striped/pooled, received straight into a local shm mapping
+            # (one copy) — the head never touches the payload
+            # (object_manager.h:206).  The returned buffers are zero-copy
+            # views over the received mapping; they keep it alive.
+            caps = tuple(agent.info.get("object_caps") or ())
             try:
-                buf = self._puller.fetch(home, addr, descr[1])
-                return object_transfer.parse_segment_bytes(buf)
+                seg = object_transfer.pull_to_segment(
+                    self._puller, self.shm, home, addr, descr[1],
+                    caps=caps)
+                return seg.raw_parts()
             except exc.ObjectLostError:
                 raise
             except Exception:
@@ -1752,6 +1779,11 @@ class Runtime:
             # dir (per-node spilling; local_object_manager.h:41).
             "RAY_TPU_STORE_BYTES": str(self.config.object_store_memory),
             "RAY_TPU_SPILL_DIR_OVERRIDE": self.spill_dir,
+            # Data-plane knobs (pooled/striped cross-node pulls) follow
+            # _system_config overrides into workers via the env namespace.
+            "RAY_TPU_OBJECT_POOL_SIZE": str(self.config.object_pool_size),
+            "RAY_TPU_OBJECT_STRIPE_THRESHOLD":
+                str(self.config.object_stripe_threshold),
         })
         env["RAY_TPU_STORE_ID"] = self.store_id
         # Worker output goes to a per-worker file (reference: workers log
@@ -1803,12 +1835,22 @@ class Runtime:
             "RAY_TPU_NODE_ID": node.node_id.hex(),
             "RAY_TPU_JOB_ID": self.job_id.hex(),
             "RAY_TPU_POOL_BYTES": str(self.config.shm_pool_bytes),
+            "RAY_TPU_OBJECT_POOL_SIZE": str(self.config.object_pool_size),
+            "RAY_TPU_OBJECT_STRIPE_THRESHOLD":
+                str(self.config.object_stripe_threshold),
         })
         w = WorkerHandle(worker_id, None, None, node, env_key, tpu_chips)
         node.all_workers[id(w)] = w
         self._pending_workers[worker_id.hex()] = w
         node.agent.send(("spawn_worker", worker_id.hex(), overrides))
         return w
+
+    def _object_server_loop(self):
+        """The head's object server: same shared accept loop the node
+        agents run, serving segments from the head's own store."""
+        object_transfer.accept_loop(self._obj_listener, self.shm,
+                                    lambda: self._stopped,
+                                    "ray_tpu-objconn")
 
     def _accept_loop(self, listener):
         while not self._stopped:
@@ -2838,13 +2880,31 @@ class Runtime:
                 self._queue_send(worker, ("reply", rid, e))
         elif tag == "store_addr":
             # Location brokering only (reference: the owner-based object
-            # directory answering WHERE, never carrying bytes).
+            # directory answering WHERE, never carrying bytes).  Replies
+            # (addr, caps): the advertised verb set lets pullers stripe
+            # against peers that speak fetch_range without ever probing
+            # one that doesn't.  The HEAD's own store has an object
+            # server too, so head-homed segments are pulled directly
+            # instead of relayed through getparts.  Compat note: a
+            # pre-caps worker handed this tuple fails its address parse
+            # and degrades to the (pre-existing) getparts relay — safe
+            # but slow; the inverse (new worker, bare-addr old head) is
+            # parsed explicitly in _direct_pull.  A new request tag
+            # can't fix this: old heads drop unknown tags without
+            # replying, which would hang the requester instead.
             _, rid, store_hex = msg
-            with self.lock:
-                agent = self._agents.get(store_hex)
-                addr = (agent.info.get("object_addr")
-                        if agent is not None and not agent.dead else None)
-            self._queue_send(worker, ("reply", rid, addr))
+            if store_hex == self.store_id:
+                reply = (self.object_addr, object_transfer.CAPS)
+            else:
+                with self.lock:
+                    agent = self._agents.get(store_hex)
+                    alive = agent is not None and not agent.dead
+                    addr = (agent.info.get("object_addr")
+                            if alive else None)
+                    caps = (tuple(agent.info.get("object_caps") or ())
+                            if alive else ())
+                reply = (addr, caps) if addr else None
+            self._queue_send(worker, ("reply", rid, reply))
         elif tag == "state_req":
             _, rid, kind, kwargs = msg
             try:
@@ -3736,6 +3796,14 @@ class Runtime:
         try:
             self._listener.close()
             self._tcp_listener.close()
+        except Exception:
+            pass
+        try:
+            self._obj_listener.close()
+        except Exception:
+            pass
+        try:
+            self._puller.close()
         except Exception:
             pass
         for agent in list(self._agents.values()):

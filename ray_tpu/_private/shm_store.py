@@ -126,13 +126,14 @@ class Segment:
     def close(self):
         # The deserialized value may hold views into the mapping; mmap.close
         # will fail with BufferError if so — let the GC of those arrays
-        # release it instead.
+        # release it instead.  AttributeError: heap-backed receive
+        # fallbacks wrap a bytearray, which has nothing to close.
         if self._closed:
             return
         self._closed = True
         try:
             self._mm.close()
-        except BufferError:
+        except (BufferError, AttributeError):
             pass
 
 
@@ -313,6 +314,66 @@ class ShmStore:
         finally:
             os.close(fd)
         return name, mm, total
+
+    # ------------------------------------------------- zero-copy receive --
+    # The cross-node puller's destination buffers (object_transfer.
+    # pull_to_segment): reserve a writable mapping up front, let the
+    # network stack recv_bytes_into it at final offsets, then seal it as
+    # a read Segment.  The backing file is unlinked the moment the
+    # mapping exists (an mmap binds the inode, not the path), so a
+    # received replica is private to this process, can never collide
+    # with the canonical segment name, needs no free/eviction
+    # bookkeeping, and cannot leak even if the process dies
+    # mid-receive — the kernel reclaims the pages when the last view
+    # over the mapping is dropped.
+
+    def reserve_recv(self, name: str, total: int) -> mmap.mmap:
+        """A writable ``total``-byte shm mapping for an incoming copy of
+        segment ``name``.  Pair with ``commit_recv`` (success) or
+        ``abort_recv`` (failure)."""
+        if total <= 0:
+            raise ValueError(f"cannot reserve {total}-byte segment {name}")
+        if self._capacity:
+            # Reservations are transient (freed when the consumer drops
+            # the value) and deliberately NOT added to the node counter —
+            # but a pull that clearly cannot fit must not sparsely
+            # overcommit tmpfs and SIGBUS mid-receive.  Raising here
+            # sends the caller to its heap-buffer fallback
+            # (object_transfer.pull_to_segment), which keeps the store's
+            # accounted capacity intact — the pre-reserve behavior.
+            with self._lock:
+                used = self._node_used()
+            if used + total > self._capacity:
+                raise MemoryError(
+                    f"recv reservation over store capacity: need {total}, "
+                    f"node used {used}/{self._capacity}")
+        # basename: remote SPILLED descriptors name segments by absolute
+        # path; the reservation always lives in THIS store's directory.
+        path = _segment_path(
+            self._dir,
+            f"{os.path.basename(name)}.recv-{os.urandom(4).hex()}")
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, total)
+            mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return mm
+
+    def commit_recv(self, name: str, mm: mmap.mmap, total: int) -> Segment:
+        """Seal a filled reservation as a read Segment (its buffers are
+        zero-copy views over the received mapping)."""
+        return Segment(name, "", total, mm)
+
+    def abort_recv(self, mm: mmap.mmap):
+        try:
+            mm.close()
+        except BufferError:
+            pass
 
     def attach(self, name: str) -> Segment:
         return self.attach_path(_segment_path(self._dir, name))

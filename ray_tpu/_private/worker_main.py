@@ -97,7 +97,10 @@ class _WorkerRuntime:
         # the owner's directory, object_manager.h:206).
         self._puller = object_transfer.ObjectPuller(
             bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")))
-        self._store_addrs: Dict[str, Any] = {}  # store_id -> addr|None
+        # store_id -> (addr, caps) for stores with a reachable object
+        # server; misses are never cached (a recovering peer gets its
+        # fast path back on the next pull).
+        self._store_addrs: Dict[str, Any] = {}
         # Completed-task results buffered between queue drains: back-to-
         # back short tasks ride to the driver as ONE result_batch message
         # (reference: batched reply streams; kills per-task head wakeups).
@@ -386,19 +389,38 @@ class _WorkerRuntime:
 
     def _direct_pull(self, descr):
         store = descr[3]
-        if store not in self._store_addrs:
-            self._store_addrs[store] = self._request(
+        ent = self._store_addrs.get(store)
+        if ent is None:
+            reply = self._request(
                 lambda rid: ("store_addr", rid, store))
-        addr = self._store_addrs[store]
-        if not addr:
-            return _PULL_MISS
+            # (addr, caps) from this release's head; a bare addr (no
+            # advertised verbs) from an older one.
+            if isinstance(reply, tuple):
+                addr, caps = reply[0], tuple(reply[1] or ())
+            else:
+                addr, caps = reply, ()
+            if not addr:
+                # No server right now (agent dead or mid-restart): do
+                # NOT cache the miss — the next pull re-asks, so a
+                # recovered peer gets its fast path back.  The relay
+                # fallback this returns into is far costlier than the
+                # one extra location lookup.
+                return _PULL_MISS
+            ent = self._store_addrs[store] = (addr, caps)
+        addr, caps = ent
         try:
-            buf = self._puller.fetch(store, addr, descr[1])
-            meta, bufs = object_transfer.parse_segment_bytes(buf)
+            # One-copy receive: chunks land straight in a local shm
+            # mapping; deserialization builds zero-copy views over it
+            # (the value's arrays keep the mapping alive).
+            seg = object_transfer.pull_to_segment(
+                self._puller, self.shm, store, addr, descr[1], caps=caps)
+            meta, bufs = seg.raw_parts()
             return serialization.loads(meta, bufs)
         except Exception:
             # Agent gone or segment moved: the owner knows the truth —
             # fall back to the brokered path (which also drives recovery).
+            # Forget the cached address so a restarted peer re-resolves.
+            self._store_addrs.pop(store, None)
             return _PULL_MISS
 
     def serialize_value(self, value: Any, object_id: ObjectID):

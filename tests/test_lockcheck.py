@@ -241,30 +241,33 @@ class _DeadConn:
 
 def test_object_puller_lock_order_convention(checker, monkeypatch):
     """object_transfer.ObjectPuller's documented convention: the registry
-    lock and per-connection locks are independent leaves — the recorded
-    acquisition graph must contain NO edge between them (in either
-    direction), even on the fetch-failure path where drop() follows a
-    held connection lock."""
+    lock and every pool's condition lock are independent leaves — the
+    recorded acquisition graph must contain NO edge between them (in
+    either direction), even on the fetch-failure path where evict()
+    (condition lock) follows a failed stream on an exclusively-held
+    connection."""
     import multiprocessing.connection
 
     from ray_tpu._private.object_transfer import ObjectPuller
 
     monkeypatch.setattr(multiprocessing.connection, "Client",
                         lambda addr, authkey=None: _DeadConn())
-    puller = ObjectPuller(authkey=b"x")
+    puller = ObjectPuller(authkey=b"x", pool_size=2, stripe_threshold=0)
     assert isinstance(puller._lock, lockcheck._LockProxy)
     with pytest.raises(OSError):
         puller.fetch("store-1", "tcp://127.0.0.1:1", "segment")
-    # The failed fetch exercised: registry (dial bookkeeping), the
-    # connection lock across the send, and registry again in drop().
-    conn_sites = {ent[1]._site for ent in puller._conns.values()}
+    # The failed fetch exercised: registry (pool creation), the pool
+    # condition (acquire's count bump, dial outside it, evict's count
+    # drop + waiter wakeup), and the stream send on a lock-free
+    # exclusively-acquired connection.
+    pool = puller._pools["store-1"]
     registry_site = puller._lock._site
-    # drop() popped the dead conn, so recover its site from the graph if
-    # needed; with the conn gone, just assert the global property:
+    pool_site = pool.cv._lock._site
     edges = lockcheck.edges()
-    for conn_site in conn_sites:
-        assert registry_site not in edges.get(conn_site, set())
-        assert conn_site not in edges.get(registry_site, set())
+    assert pool_site not in edges.get(registry_site, set()), (
+        "registry lock held while taking a pool condition lock")
+    assert registry_site not in edges.get(pool_site, set()), (
+        "pool condition lock held while taking the registry lock")
     assert all(registry_site not in targets
                for targets in edges.values()), (
         f"some lock is held while acquiring the registry lock: {edges}")

@@ -1,0 +1,574 @@
+"""Data-plane fast path: pooled connections, striped byte-range fetch,
+zero-copy receive into shm, and the head staying out of the payload path.
+
+Reference analog: the object manager moves objects directly between nodes
+in bounded chunks with multiple transfers in flight
+(``src/ray/object_manager/object_manager.h:117,206``,
+``object_buffer_pool.h``); the control plane brokers locations only.
+
+Covered here:
+- striped ``fetch_range`` reassembly is byte-identical across randomized
+  sizes around the stripe threshold;
+- N concurrent pulls from one peer genuinely stream in parallel
+  (deterministic gate, no timing);
+- old-verb peer interop: a peer speaking only ``fetch`` still serves a
+  pooled puller, and unknown verbs are never sent to it;
+- server death mid-stream surfaces a transport error, the broken
+  connection is evicted in isolation (later fetches redial), and the
+  driver's head-relay fallback engages and is counted;
+- the acceptance micro: ≥2x aggregate throughput for 4 concurrent 64 MB
+  pulls from one peer vs. the serial single-connection baseline over a
+  paced (latency-bound) link — pacing makes the assertion independent of
+  this machine's loopback memory bandwidth while still exercising the
+  real multiple-transfers-in-flight machinery;
+- a two-node-agent cluster where a ≥100 MB result (both node-homed and
+  HEAD-homed) reaches remote consumers with the
+  ``relayed_segments``/``brokered_parts`` fallback counters flat;
+- the concurrency cases re-run under the lockcheck instrumentation
+  (the RAY_TPU_LOCKCHECK machinery) with zero lock-order cycles.
+"""
+
+import os
+import random
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiprocessing.connection import Listener
+
+from ray_tpu._private import object_transfer as ot
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.shm_store import ShmStore
+
+AUTH = b"object-transfer-test"
+
+
+# --------------------------------------------------------------- helpers --
+
+def _make_segment(store: ShmStore, payload: bytes) -> str:
+    """A real shm segment holding one bytes buffer; returns its name."""
+    res = serialization.dumps_adaptive(
+        np.frombuffer(payload, dtype=np.uint8), 0)
+    assert res[0] == "parts"
+    name, _size = store.create_from_parts(ObjectID.from_random(), res[1],
+                                          res[2])
+    return name
+
+
+def _value_of(buf) -> bytes:
+    meta, bufs = ot.parse_segment_bytes(buf)
+    return serialization.loads(meta, bufs).tobytes()
+
+
+class _Server:
+    """A loopback object server over a real store, with optional
+    per-connection wrapping (pacing, gating, chaos)."""
+
+    def __init__(self, store, wrap=None, serve=ot.serve_connection):
+        self.store = store
+        self._wrap = wrap or (lambda conn: conn)
+        self._serve = serve
+        self._listener = Listener(("127.0.0.1", 0), "AF_INET",
+                                  backlog=16, authkey=AUTH)
+        self.addr = f"tcp://127.0.0.1:{self._listener.address[1]}"
+        self._stopped = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stopped:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                return
+            threading.Thread(target=self._serve,
+                             args=(self._wrap(conn), self.store),
+                             daemon=True).start()
+
+    def close(self):
+        self._stopped = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
+@pytest.fixture
+def shm_store():
+    d = tempfile.mkdtemp(prefix="rtpu-ot-", dir="/dev/shm"
+                         if os.path.isdir("/dev/shm") else None)
+    store = ShmStore(shm_dir=d, session_id="ottest")
+    yield store
+    import shutil
+
+    shutil.rmtree(d, ignore_errors=True)
+
+
+# ------------------------------------------------- striped reassembly ----
+
+def test_striped_fetch_reassembles_byte_identical(shm_store):
+    """Randomized sizes around the stripe threshold: whole-segment fetch,
+    striped fetch and the zero-copy pull_to_segment path must all yield
+    identical values."""
+    thr = 256 * 1024
+    rng = random.Random(7)
+    sizes = [1, thr // 2, thr - 64, thr - 1, thr, thr + 1, thr + 177,
+             2 * thr, 3 * thr + rng.randrange(thr)]
+    server = _Server(shm_store)
+    puller = ot.ObjectPuller(AUTH, pool_size=4, stripe_threshold=thr)
+    local = ShmStore(shm_dir=shm_store._dir, session_id="otlocal")
+    try:
+        for n in sizes:
+            payload = rng.randbytes(n)
+            name = _make_segment(shm_store, payload)
+            plain = puller.fetch("peer", server.addr, name)
+            striped = puller.fetch("peer", server.addr, name,
+                                   caps=("fetch_range",))
+            assert bytes(striped) == bytes(plain), f"size {n}"
+            assert _value_of(striped) == payload, f"size {n}"
+            seg = ot.pull_to_segment(puller, local, "peer", server.addr,
+                                     name, caps=("fetch_range",))
+            meta, bufs = seg.raw_parts()
+            assert serialization.loads(meta, bufs).tobytes() == payload
+            seg.close()
+    finally:
+        puller.close()
+        server.close()
+
+
+def test_reserve_over_capacity_falls_back_to_heap(shm_store):
+    """A receive that cannot fit under the store's capacity must not
+    sparsely overcommit tmpfs: reserve_recv raises MemoryError and
+    pull_to_segment completes the transfer into a heap buffer instead."""
+    server = _Server(shm_store)
+    payload = random.Random(23).randbytes(1 << 20)
+    name = _make_segment(shm_store, payload)
+    capped = ShmStore(shm_dir=shm_store._dir, session_id="otcap",
+                      capacity=64 * 1024)
+    with pytest.raises(MemoryError):
+        capped.reserve_recv("seg", 1 << 20)
+    puller = ot.ObjectPuller(AUTH, pool_size=2, stripe_threshold=0)
+    try:
+        seg = ot.pull_to_segment(puller, capped, "peer", server.addr, name)
+        meta, bufs = seg.raw_parts()
+        assert serialization.loads(meta, bufs).tobytes() == payload
+        assert isinstance(seg._mm, bytearray)  # heap fallback engaged
+        seg.close()
+        assert not any(".recv-" in f for f in os.listdir(shm_store._dir))
+    finally:
+        puller.close()
+        server.close()
+        capped.cleanup()
+
+
+def test_reserve_commit_recv_leaves_no_files(shm_store):
+    mm = shm_store.reserve_recv("seg-x", 4096)
+    assert not any(".recv-" in f for f in os.listdir(shm_store._dir)), \
+        "reservation left a linked file"
+    mm[:5] = b"hello"
+    seg = shm_store.commit_recv("seg-x", mm, 4096)
+    assert bytes(seg._mm[:5]) == b"hello"
+    seg.close()
+    mm2 = shm_store.reserve_recv("seg-y", 4096)
+    shm_store.abort_recv(mm2)
+    with pytest.raises(ValueError):
+        shm_store.reserve_recv("seg-z", 0)
+
+
+# ------------------------------------------- parallel streams from peer --
+
+class _GateConn:
+    """Blocks every payload-sized send until ``need`` distinct
+    connections have reached one — a deterministic proof that streams
+    overlap in time (a serialized puller would deadlock the gate and
+    fail fast instead of flaking on timing)."""
+
+    def __init__(self, conn, gate):
+        self._conn = conn
+        self._gate = gate
+
+    def send_bytes(self, data):
+        if len(data) >= 65536:
+            self._gate.arrive(id(self._conn))
+        self._conn.send_bytes(data)
+
+    def __getattr__(self, item):
+        return getattr(self._conn, item)
+
+
+class _Gate:
+    def __init__(self, need: int):
+        self._need = need
+        self._seen = set()
+        self._lock = threading.Lock()
+        self._ev = threading.Event()
+
+    def arrive(self, key):
+        with self._lock:
+            self._seen.add(key)
+            if len(self._seen) >= self._need:
+                self._ev.set()
+        if not self._ev.wait(10):
+            raise RuntimeError("streams did not overlap")
+
+
+def test_concurrent_pulls_stream_in_parallel(shm_store):
+    """Two concurrent fetches of different segments from ONE peer must
+    stream simultaneously on separate pooled connections."""
+    gate = _Gate(2)
+    server = _Server(shm_store, wrap=lambda c: _GateConn(c, gate))
+    rng = random.Random(11)
+    names = [_make_segment(shm_store, rng.randbytes(2 << 20))
+             for _ in range(2)]
+    puller = ot.ObjectPuller(AUTH, pool_size=4, stripe_threshold=0)
+    results = {}
+
+    def pull(name):
+        results[name] = _value_of(puller.fetch("peer", server.addr, name))
+
+    try:
+        threads = [threading.Thread(target=pull, args=(n,)) for n in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 2
+        pool = puller._pools["peer"]
+        assert pool.total >= 2, "pulls shared one connection"
+    finally:
+        puller.close()
+        server.close()
+
+
+# ------------------------------------------------- old-verb peer interop --
+
+def _old_serve_connection(conn, store):
+    """The pre-pool object server, verbatim: speaks ONLY fetch/close and
+    silently ignores anything else (which is why new verbs must be gated
+    on advertised caps, never probed)."""
+    unknown = getattr(store, "_unknown_verbs", None)
+    try:
+        while True:
+            msg = protocol.recv(conn)
+            if msg[0] == "fetch":
+                name = msg[1]
+                try:
+                    seg = store.attach(name)
+                except Exception as e:  # noqa: BLE001
+                    protocol.send(conn, ("err", repr(e)))
+                    continue
+                try:
+                    mv = memoryview(seg._mm)
+                    total = ot._true_extent(mv, name)
+                    protocol.send(conn, ("ok", total))
+                    for off in range(0, total, ot.CHUNK):
+                        conn.send_bytes(mv[off:min(off + ot.CHUNK, total)])
+                finally:
+                    del mv
+                    seg.close()
+            elif msg[0] == "close":
+                return
+            elif unknown is not None:
+                unknown.append(msg[0])
+    except (EOFError, OSError, TypeError):
+        return
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+def test_old_verb_peer_interop(shm_store):
+    """A peer that only speaks the original ``fetch`` verb (empty caps)
+    serves a pooled puller correctly — and never receives a verb it
+    doesn't know."""
+    shm_store._unknown_verbs = []
+    server = _Server(shm_store, serve=_old_serve_connection)
+    payload = random.Random(3).randbytes(600 * 1024)
+    name = _make_segment(shm_store, payload)
+    # A striping-eager puller whose threshold the segment EXCEEDS: with
+    # no advertised caps it must still use plain fetch.
+    puller = ot.ObjectPuller(AUTH, pool_size=3, stripe_threshold=128 * 1024)
+    try:
+        got = puller.fetch("old-peer", server.addr, name, caps=())
+        assert _value_of(got) == payload
+        local = ShmStore(shm_dir=shm_store._dir, session_id="otlocal2")
+        seg = ot.pull_to_segment(puller, local, "old-peer", server.addr,
+                                 name, caps=())
+        meta, bufs = seg.raw_parts()
+        assert serialization.loads(meta, bufs).tobytes() == payload
+        seg.close()
+        assert shm_store._unknown_verbs == [], \
+            f"sent unknown verbs to an old peer: {shm_store._unknown_verbs}"
+    finally:
+        puller.close()
+        server.close()
+
+
+# ------------------------------------------ failure isolation / recovery --
+
+class _DieAfterFirstChunk:
+    """Kills the connection after the first payload chunk of the FIRST
+    stream served by this server process."""
+
+    armed = True
+
+    def __init__(self, conn, owner):
+        self._conn = conn
+        self._owner = owner
+
+    def send_bytes(self, data):
+        if len(data) >= ot.CHUNK and self._owner["armed"]:
+            self._owner["armed"] = False
+            self._conn.close()
+            raise OSError("injected mid-stream death")
+        self._conn.send_bytes(data)
+
+    def __getattr__(self, item):
+        return getattr(self._conn, item)
+
+
+def test_mid_stream_death_is_isolated_and_recovers(shm_store):
+    """A connection dying mid-stream fails that fetch with a transport
+    error, evicts ONLY that connection, and a retry on the same pool
+    redials and succeeds.  A missing segment surfaces ObjectLostError."""
+    from ray_tpu import exceptions as exc
+
+    owner = {"armed": True}
+    server = _Server(shm_store,
+                     wrap=lambda c: _DieAfterFirstChunk(c, owner))
+    payload = random.Random(5).randbytes(3 << 20)
+    name = _make_segment(shm_store, payload)
+    puller = ot.ObjectPuller(AUTH, pool_size=2, stripe_threshold=0)
+    try:
+        with pytest.raises((OSError, EOFError)):
+            puller.fetch("peer", server.addr, name)
+        # Pool evicted just the broken connection; the retry dials a
+        # fresh one and completes.
+        got = puller.fetch("peer", server.addr, name)
+        assert _value_of(got) == payload
+        with pytest.raises(exc.ObjectLostError):
+            puller.fetch("peer", server.addr, "rtpu-ottest-missing")
+    finally:
+        puller.close()
+        server.close()
+
+
+# -------------------------------------------------- the acceptance micro --
+
+class _PacedConn:
+    """Fixed per-send pacing: emulates a latency/bandwidth-bound link, the
+    regime where multiple transfers in flight beat one serial stream —
+    and the assertion stays independent of this machine's loopback
+    memory bandwidth."""
+
+    def __init__(self, conn, delay):
+        self._conn = conn
+        self._delay = delay
+
+    def send_bytes(self, data):
+        if len(data) >= ot.CHUNK:
+            time.sleep(self._delay)
+        self._conn.send_bytes(data)
+
+    def __getattr__(self, item):
+        return getattr(self._conn, item)
+
+
+def test_four_concurrent_64mb_pulls_2x_over_serial(shm_store):
+    """Acceptance micro: 4 concurrent 64 MB pulls from one peer over a
+    paced link — the pooled + striped puller must show ≥2x aggregate
+    throughput over the serial single-connection baseline (the pre-pool
+    behavior: one connection per peer, one whole-segment stream at a
+    time)."""
+    server = _Server(shm_store, wrap=lambda c: _PacedConn(c, 0.012))
+    base = np.arange(8_000_000, dtype=np.int64).tobytes()  # 64 MB
+    names = [_make_segment(shm_store, base) for _ in range(4)]
+
+    def timed(puller, caps):
+        errs = []
+
+        def pull(name):
+            try:
+                got = puller.fetch("peer", server.addr, name, caps=caps)
+                assert _value_of(got) == base
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=pull, args=(n,)) for n in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        return time.perf_counter() - t0
+
+    serial = ot.ObjectPuller(AUTH, pool_size=1, stripe_threshold=0)
+    pooled = ot.ObjectPuller(AUTH, pool_size=4,
+                             stripe_threshold=16 * 1024 * 1024)
+    try:
+        best = 0.0
+        for _attempt in range(3):  # damp shared-CI scheduling noise
+            t_serial = timed(serial, ())
+            t_pooled = timed(pooled, ("fetch_range",))
+            best = max(best, t_serial / t_pooled)
+            if best >= 2.0:
+                break
+        assert best >= 2.0, (
+            f"pooled/striped path only {best:.2f}x over serial baseline")
+    finally:
+        serial.close()
+        pooled.close()
+        server.close()
+
+
+# --------------------------------------------- lockcheck on concurrency --
+
+def test_concurrent_striped_pulls_lockcheck_clean(shm_store):
+    """The concurrency cases under the RAY_TPU_LOCKCHECK instrumentation:
+    pooled + striped concurrent pulls must record zero lock-order
+    cycles."""
+    from ray_tpu.devtools import lockcheck
+
+    lockcheck.install(raise_on_cycle=False)
+    lockcheck.clear()
+    try:
+        server = _Server(shm_store)
+        rng = random.Random(13)
+        payloads = [rng.randbytes(700 * 1024) for _ in range(3)]
+        names = [_make_segment(shm_store, p) for p in payloads]
+        puller = ot.ObjectPuller(AUTH, pool_size=3,
+                                 stripe_threshold=128 * 1024)
+        local = ShmStore(shm_dir=shm_store._dir, session_id="otlock")
+        results = {}
+
+        def pull(i, name):
+            seg = ot.pull_to_segment(puller, local, "peer", server.addr,
+                                     name, caps=("fetch_range",))
+            meta, bufs = seg.raw_parts()
+            results[i] = serialization.loads(meta, bufs).tobytes()
+            seg.close()
+
+        threads = [threading.Thread(target=pull, args=(i, n))
+                   for i, n in enumerate(names)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert [results[i] for i in range(3)] == payloads
+        puller.close()
+        server.close()
+        assert lockcheck.violations() == [], lockcheck.violations()
+        lockcheck.assert_acyclic()
+    finally:
+        lockcheck.uninstall()
+
+
+# ------------------------------------------- cluster: head out of the way --
+
+@pytest.fixture
+def cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_num_cpus=2)
+    yield c
+    c.shutdown()
+
+
+def test_big_results_skip_head_payload_path(cluster):
+    """A ≥100 MB result reaches remote consumers without the head ever
+    relaying payload bytes, whether the segment is homed on a NODE store
+    or on the HEAD's own store (the head now runs an object server for
+    itself): ``brokered_parts``/``relayed_segments`` stay flat."""
+    import ray_tpu as ray
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy as NA,
+    )
+
+    n1 = cluster.add_node(num_cpus=2, external=True)
+    n2 = cluster.add_node(num_cpus=2, external=True)
+
+    @ray.remote
+    def make(n):
+        return np.arange(n, dtype=np.int64)
+
+    @ray.remote
+    def total(x):
+        return int(x.sum())
+
+    n_elems = 13_000_000  # 104 MB of int64
+    expect = int(np.arange(n_elems, dtype=np.int64).sum())
+
+    # Warm both nodes' worker pools before baselining the counters.
+    ray.get([
+        total.options(scheduling_strategy=NA(node_id=nid)).remote(
+            make.options(scheduling_strategy=NA(node_id=nid)).remote(8))
+        for nid in (n1, n2)
+    ])
+    base_relay = cluster.rt.relayed_segments
+    base_broker = cluster.rt.brokered_parts
+
+    # Node-homed result: produced on node1, consumed on node2 AND by the
+    # driver — direct pulls from node1's object server.
+    ref = make.options(scheduling_strategy=NA(node_id=n1)).remote(n_elems)
+    s = ray.get(
+        total.options(scheduling_strategy=NA(node_id=n2)).remote(ref),
+        timeout=180)
+    assert s == expect
+    got = ray.get(ref, timeout=120)
+    assert int(got.sum()) == expect
+    del got, ref
+
+    # HEAD-homed result: produced by a head-local worker, consumed on an
+    # external node — previously a brokered getparts relay through the
+    # head's control-plane connection, now a direct pull from the head's
+    # own object server.
+    head_id = cluster.rt.head_node.node_id.hex()
+    head_ref = make.options(
+        scheduling_strategy=NA(node_id=head_id)).remote(n_elems)
+    ray.wait([head_ref], num_returns=1, timeout=120)
+    s2 = ray.get(
+        total.options(scheduling_strategy=NA(node_id=n2)).remote(head_ref),
+        timeout=180)
+    assert s2 == expect
+    del head_ref
+
+    assert cluster.rt.relayed_segments == base_relay, \
+        "head relayed segment payload bytes"
+    assert cluster.rt.brokered_parts == base_broker, \
+        "a consumer fell back to head-brokered getparts"
+
+
+def test_pull_failure_falls_back_to_head_relay(cluster, monkeypatch):
+    """When the direct pull path breaks (object server unreachable), the
+    driver's get still succeeds via the head relay — and the fallback is
+    observable through ``relayed_segments``."""
+    import ray_tpu as ray
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy as NA,
+    )
+
+    n1 = cluster.add_node(num_cpus=2, external=True)
+
+    @ray.remote
+    def make(n):
+        return np.arange(n, dtype=np.int64)
+
+    ref = make.options(scheduling_strategy=NA(node_id=n1)).remote(500_000)
+    ray.wait([ref], num_returns=1, timeout=60)
+
+    def broken_fetch(*args, **kwargs):
+        raise OSError("injected: object server unreachable")
+
+    monkeypatch.setattr(cluster.rt._puller, "fetch", broken_fetch)
+    base_relay = cluster.rt.relayed_segments
+    got = ray.get(ref, timeout=60)
+    assert int(got.sum()) == int(
+        np.arange(500_000, dtype=np.int64).sum())
+    assert cluster.rt.relayed_segments > base_relay, \
+        "broken direct pull did not engage the head relay"
