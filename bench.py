@@ -325,6 +325,81 @@ def _client_bench():
     return {}
 
 
+def locality_bench():
+    """Arg-locality microbench: a fan-out of tasks over one node-homed
+    large arg, run with locality scheduling on and off — reports tasks/s
+    and off_home_arg_bytes, the per-task upper bound on cross-node arg
+    traffic (tasks that ran away from the arg's home node x arg size;
+    singleflight dedup means actual wire bytes can be lower), so this
+    PR's effect and regressions stay visible in the round trajectory."""
+    import os
+
+    import numpy as np
+
+    import ray_tpu as ray
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy as NA,
+    )
+
+    arg_bytes = 8 << 20
+    n_tasks = 64
+
+    @ray.remote
+    def make(n):
+        return np.ones(n, np.uint8)
+
+    @ray.remote
+    def crunch(a):
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    def run(system_config):
+        c = Cluster(head_num_cpus=4, _system_config=system_config)
+        try:
+            home = c.add_node(num_cpus=4, external=True)
+            ref = make.options(scheduling_strategy=NA(home)).remote(
+                arg_bytes)
+            ray.wait([ref], num_returns=1, timeout=60)
+            ray.get([crunch.remote(ref) for _ in range(4)], timeout=120)
+            t0 = time.perf_counter()
+            nodes = ray.get([crunch.remote(ref) for _ in range(n_tasks)],
+                            timeout=300)
+            dt = time.perf_counter() - t0
+            # Worker prefetch/dedup deltas arrive on the periodic
+            # flusher: wait for the counters to settle before recording.
+            stats = c.rt.transfer_stats()
+            deadline = time.perf_counter() + 3.0
+            while time.perf_counter() < deadline:
+                time.sleep(0.3)
+                nxt = c.rt.transfer_stats()
+                if nxt == stats:
+                    break
+                stats = nxt
+            return {
+                "tasks_per_s": round(n_tasks / dt, 1),
+                "off_home_arg_bytes":
+                    sum(1 for nd in nodes if nd != home) * arg_bytes,
+                "on_home_node": nodes.count(home),
+                "locality_hits": stats["locality_hits"],
+                "locality_misses": stats["locality_misses"],
+                "locality_bytes_saved": stats["locality_bytes_saved"],
+                "prefetch_hit_bytes": stats["prefetch_hit_bytes"],
+                "deduped_pulls": stats["deduped_pulls"],
+            }
+        finally:
+            c.shutdown()
+
+    out = {"arg_mb": arg_bytes >> 20, "n_tasks": n_tasks,
+           "locality_on": run(None),
+           "locality_off": run({"locality_scheduling": False})}
+    print(f"  [locality] on: {out['locality_on']['tasks_per_s']}/s, "
+          f"{out['locality_on']['off_home_arg_bytes'] >> 20} MB off-home; "
+          f"off: {out['locality_off']['tasks_per_s']}/s, "
+          f"{out['locality_off']['off_home_arg_bytes'] >> 20} MB off-home",
+          file=sys.stderr)
+    return out
+
+
 # Peak bf16 FLOP/s by device kind (for MFU).
 _PEAK_FLOPS = {
     "TPU v4": 275e12,
@@ -535,6 +610,12 @@ def main():
     geo_capped = geomean([min(r, 4.0) for r in ratios])
 
     try:
+        locality = locality_bench()
+    except Exception as e:  # noqa: BLE001 — extra row must not kill core
+        print(f"  [locality] bench failed: {e!r}", file=sys.stderr)
+        locality = {"error": repr(e)}
+
+    try:
         tpu = tpu_bench()
     except Exception as e:  # noqa: BLE001 — device bench must not kill core
         print(f"  [tpu] device bench failed: {e!r}", file=sys.stderr)
@@ -547,6 +628,7 @@ def main():
         "vs_baseline": round(geo, 4),
         "geomean_wins_capped_at_4x": round(geo_capped, 4),
         "non_comparable": extras,
+        "arg_locality": locality,
         "tpu": tpu,
     }))
 

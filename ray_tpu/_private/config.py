@@ -69,6 +69,29 @@ class Config:
     # listen_host.
     object_advertise_host: str = ""
 
+    # --- Locality-aware scheduling (reference:
+    # scheduling/policy/hybrid_scheduling_policy.cc — lease selection
+    # prefers the node holding the task's argument bytes).  The default
+    # policy scores candidate nodes by argument bytes homed in their
+    # object store and prefers the top-locality node that fits; it never
+    # stalls a class (a preferred-but-full node just falls back to the
+    # head-first order, counted in ``locality_misses``).
+    locality_scheduling: bool = True
+    # Minimum bytes of node-homed argument data before locality overrides
+    # the head-first placement order (below it, transfer is cheaper than
+    # disturbing the packing).
+    locality_min_bytes: int = 1024 * 1024
+
+    # --- Pipelined argument prefetch (reference: raylets pull task
+    # dependencies before the worker starts so transfer overlaps
+    # compute).  While a worker computes, up to this many concurrent
+    # pulls materialize the REMOTE shm args of tasks queued behind it;
+    # ``_load_args`` then consumes the prefetched segments.  Also caps
+    # the concurrent pulls _load_args itself issues for a multi-arg
+    # task.  0 disables prefetching (args materialize serially on the
+    # task's critical path, the pre-PR behavior).
+    arg_prefetch_depth: int = 2
+
     # Seconds a worker may sit idle before the pool reaps it (reference:
     # idle worker killing in worker_pool.cc).
     idle_worker_timeout_s: float = 300.0

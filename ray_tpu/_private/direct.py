@@ -1313,7 +1313,8 @@ class DirectServer:
                  register_func: Callable[[str, bytes], None],
                  shm_unlink: Callable[[str, int, bool], None],
                  on_peer_msg: Optional[Callable] = None,
-                 queue_empty: Optional[Callable[[], bool]] = None):
+                 queue_empty: Optional[Callable[[], bool]] = None,
+                 on_task_queued: Optional[Callable[[dict], None]] = None):
         from multiprocessing.connection import Listener
 
         host = os.environ.get("RAY_TPU_AGENT_LISTEN_HOST", "127.0.0.1")
@@ -1332,6 +1333,12 @@ class DirectServer:
         self._shm_unlink = shm_unlink
         self._on_peer_msg = on_peer_msg
         self._queue_empty = queue_empty or (lambda: True)
+        # Called with each pushed task BEFORE it is enqueued — the
+        # worker's argument prefetcher hook: a dexec_batch burst's tasks
+        # 2..N land behind task 1 and start pulling their remote args
+        # while it computes (direct-path submissions carry the same
+        # (size, store) SHM descriptors the head path does).
+        self._on_task_queued = on_task_queued
         # Live reply channels: the worker's exec loop flushes buffered
         # replies on queue drain; the periodic flusher bounds latency.
         self._sources: set = set()
@@ -1390,11 +1397,15 @@ class DirectServer:
             task = msg[2]
             task["_dreply"] = (src, msg[1])
             src.note_enqueued(1)
+            if self._on_task_queued is not None:
+                self._on_task_queued(task)
             self._enqueue(task, src)
         elif tag == "dexec_batch":
             src.note_enqueued(len(msg[1]))
             for rid, task in msg[1]:
                 task["_dreply"] = (src, rid)
+                if self._on_task_queued is not None:
+                    self._on_task_queued(task)
                 self._enqueue(task, src)
         elif tag == "dfunc":
             self._register_func(msg[1], msg[2])

@@ -413,6 +413,12 @@ class ObjectPuller:
             t.start()
         try:
             drain(conn)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            # Signal the helpers (their loop checks ``errors``) so they
+            # stop at their next range instead of streaming the rest of
+            # a doomed transfer; failure propagates after the join.
+            errors.append(e)
+            raise
         finally:
             for t in helpers:
                 t.join()
@@ -472,6 +478,190 @@ def pull_to_segment(puller: ObjectPuller, store, store_id: str, addr: str,
     if state.get("reserved"):
         return store.commit_recv(name, state["buf"], state["total"])
     return Segment(name, "", state["total"], state["buf"])
+
+
+class _PullEntry:
+    """One in-flight (or retained prefetched) pull of a remote segment."""
+
+    __slots__ = ("event", "seg", "failed", "prefetch", "size", "evicted",
+                 "retained_at")
+
+    def __init__(self, prefetch: bool):
+        self.event = threading.Event()
+        self.seg = None          # Segment once the pull completed
+        self.failed = False
+        self.prefetch = prefetch  # started by the prefetcher (not a task)
+        self.size = 0
+        self.evicted = False     # retention cap/TTL closed the segment
+        self.retained_at = 0.0   # monotonic retain time (TTL sweep)
+
+    def wait(self, timeout: Optional[float] = None):
+        """The pulled Segment, or None when the leader's pull failed (the
+        waiter then runs its own fallback path)."""
+        if not self.event.wait(timeout):
+            return None
+        return None if self.failed else self.seg
+
+
+class PullRegistry:
+    """Per-process singleflight registry for remote-segment pulls.
+
+    N concurrent materializations of the same remote segment (executing
+    tasks + the argument prefetcher) share ONE pull: the first caller
+    becomes the leader and streams the bytes; everyone else attaches to
+    its entry and consumes the same received Segment (segments received
+    via ``reserve_recv`` are process-private mappings, so sharing one
+    read-only Segment between consumers in this process is safe).  A
+    failed pull wakes every waiter with None — each then falls back to
+    its own existing path (redial / head relay).
+
+    Prefetched pulls are RETAINED (state DONE) until a task's
+    ``_load_args`` consumes them or the retention cap evicts them
+    (evictions count as ``prefetch_waste_bytes`` — bytes pulled for a
+    task that never ran here, e.g. stolen back by the head).
+
+    Reference: the raylet's local pull manager dedup — one
+    ``ObjectManager::Pull`` per object regardless of how many queued
+    tasks depend on it (pull_manager.h).
+
+    LOCK ORDER (checked by tests/test_lockcheck.py): ``_lock`` is an
+    INDEPENDENT LEAF — it guards only the entry dict and the counters,
+    is never held across a dial, any stream I/O, or an event wait, and
+    no other lock is ever acquired under it.
+    """
+
+    # Completed prefetched segments retained for consumption; past either
+    # bound the oldest unconsumed one is evicted (counted as waste).  The
+    # byte budget keeps a burst of large prefetched-then-stolen args from
+    # pinning unbounded shm on the worker, and the TTL sweep (driven by
+    # the worker's periodic flusher) reclaims stragglers whose task never
+    # ran here even if no further prefetch ever fires.
+    RETAIN_CAP = 32
+    RETAIN_BYTES = 256 << 20
+    RETAIN_TTL_S = 10.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: Dict[tuple, _PullEntry] = {}
+        self._retained: "deque[tuple]" = deque()  # FIFO of DONE keys
+        self._retained_bytes = 0
+        self.deduped_pulls = 0       # waiters that shared a leader's pull
+        self.prefetch_hit_bytes = 0  # prefetched bytes a task consumed
+        self.prefetch_waste_bytes = 0  # prefetched bytes never consumed
+
+    def begin(self, key: tuple,
+              prefetch: bool = False) -> Tuple[_PullEntry, bool]:
+        """Join or start the pull for ``key``; returns (entry, is_leader).
+
+        A non-leader either waits on ``entry.wait()`` (pull in flight) or
+        finds ``entry.event`` already set (a retained prefetched
+        segment); task-path callers then :meth:`take` the entry to
+        consume it."""
+        with self._lock:
+            ent = self._inflight.get(key)
+            if ent is not None:
+                if not ent.event.is_set() and not prefetch:
+                    self.deduped_pulls += 1
+                return ent, False
+            ent = _PullEntry(prefetch)
+            self._inflight[key] = ent
+            return ent, True
+
+    def take(self, key: tuple, ent: _PullEntry):
+        """Consume a DONE entry's segment for task materialization (pops
+        retained prefetches and credits the hit).  Returns None when the
+        retention cap evicted (and closed) the segment between the
+        caller's begin() and now — the caller re-pulls directly
+        (_pull_remote_segment retries as a fresh leader)."""
+        with self._lock:
+            if ent.evicted:
+                return None
+            cur = self._inflight.get(key)
+            if cur is ent and ent.event.is_set():
+                self._inflight.pop(key, None)
+                try:
+                    self._retained.remove(key)
+                    self._retained_bytes -= ent.size
+                except ValueError:
+                    pass
+                if ent.prefetch and not ent.failed:
+                    self.prefetch_hit_bytes += ent.size
+        return None if ent.failed else ent.seg
+
+    def finish(self, key: tuple, ent: _PullEntry, seg, *,
+               retain: bool = False):
+        """Leader completion: publish the result and wake waiters.  With
+        ``retain`` (prefetch), a successful pull stays registered as DONE
+        until consumed or evicted."""
+        evicted = []
+        with self._lock:
+            ent.seg = seg
+            ent.failed = seg is None
+            if seg is not None:
+                ent.size = getattr(seg, "size", 0)
+            if retain and seg is not None:
+                ent.retained_at = time.monotonic()
+                self._retained.append(key)
+                self._retained_bytes += ent.size
+                while self._retained and (
+                        len(self._retained) > self.RETAIN_CAP
+                        or self._retained_bytes > self.RETAIN_BYTES):
+                    old = self._retained.popleft()
+                    old_ent = self._inflight.pop(old, None)
+                    if old_ent is not None:
+                        # Flagged under the lock; a concurrent take()
+                        # checks it under the same lock, so nobody can
+                        # receive the segment we close below.
+                        old_ent.evicted = True
+                        self._retained_bytes -= old_ent.size
+                        self.prefetch_waste_bytes += old_ent.size
+                        evicted.append(old_ent)
+            else:
+                self._inflight.pop(key, None)
+        # Outside _lock (leaf discipline): Event.set acquires the event's
+        # internal condition lock.  The result fields were published under
+        # _lock above, so woken waiters read them consistently.
+        ent.event.set()
+        for old_ent in evicted:
+            if old_ent.seg is not None:
+                old_ent.seg.close()
+
+    def sweep(self):
+        """Evict retained prefetched segments older than RETAIN_TTL_S.
+        Without this, a worker whose prefetched tasks were stolen back
+        (and that never prefetches again) would pin up to RETAIN_BYTES of
+        shm mappings until process exit — the FIFO eviction loop only
+        runs on later retains.  Called from the worker's periodic
+        flusher; retain order is FIFO, so the scan stops at the first
+        young entry."""
+        now = time.monotonic()
+        evicted = []
+        with self._lock:
+            while self._retained:
+                key = self._retained[0]
+                ent = self._inflight.get(key)
+                if ent is None:
+                    self._retained.popleft()
+                    continue
+                if now - ent.retained_at < self.RETAIN_TTL_S:
+                    break
+                self._retained.popleft()
+                self._inflight.pop(key, None)
+                ent.evicted = True
+                self._retained_bytes -= ent.size
+                self.prefetch_waste_bytes += ent.size
+                evicted.append(ent)
+        for ent in evicted:
+            if ent.seg is not None:
+                ent.seg.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "deduped_pulls": self.deduped_pulls,
+                "prefetch_hit_bytes": self.prefetch_hit_bytes,
+                "prefetch_waste_bytes": self.prefetch_waste_bytes,
+            }
 
 
 def parse_segment_bytes(buf) -> Tuple[bytes, List[memoryview]]:

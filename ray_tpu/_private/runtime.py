@@ -97,7 +97,7 @@ class TaskRecord:
     __slots__ = (
         "spec", "requirements", "deps_pending", "retries_left", "node",
         "worker", "dispatched", "cancelled", "is_actor_creation", "actor_id",
-        "pg_id", "bundle_index", "sched_key",
+        "pg_id", "bundle_index", "sched_key", "locality_homes",
     )
 
     def __init__(self, spec, requirements, retries_left):
@@ -116,7 +116,13 @@ class TaskRecord:
         # Scheduling-class tuple, computed once at first enqueue (the
         # spec's strategy/env/requirements never change afterwards) so
         # re-enqueues, cancels and dispatch scans are dict ops only.
+        # Locality NEVER folds into this key — it would shatter lease
+        # reuse; locality is resolved at pick time per record.
         self.sched_key: Optional[tuple] = None
+        # Lazily-scanned {store_id: argument bytes homed there} for
+        # locality-aware placement; scanned once at first pick (deps are
+        # READY by then, so descriptors are known and pinned).
+        self.locality_homes: Optional[Dict[str, int]] = None
 
 
 ALIVE, RESTARTING, DEAD = "ALIVE", "RESTARTING", "DEAD"
@@ -430,6 +436,20 @@ class Runtime:
             stripe_threshold=config.object_stripe_threshold)
         self.relayed_segments = 0   # head-relayed agent reads (fallback)
         self.brokered_parts = 0     # worker getparts served via the head
+        # Locality-aware placement counters (tentpole observability):
+        # hits = tasks placed on their top-locality node, misses = a
+        # preference existed but that node couldn't take the task,
+        # bytes_saved = argument bytes that did NOT cross the network
+        # because of a locality placement.
+        self.locality_hits = 0
+        self.locality_misses = 0
+        self.locality_bytes_saved = 0
+        # Worker-side data-plane counters, aggregated from periodic
+        # ("xfer_stats", {...}) deltas: singleflight pull dedup and the
+        # argument prefetcher's hit/waste bytes.
+        self.deduped_pulls = 0
+        self.prefetch_hit_bytes = 0
+        self.prefetch_waste_bytes = 0
         # Identity of this process's object store: SHM descriptors carry it
         # so consumers know whether a segment is locally attachable or must
         # be shipped (reference: owner-based object directory).
@@ -1394,7 +1414,12 @@ class Runtime:
                     self._dispatch_locked()
 
     # -------------------------------------------------------- scheduling --
-    def _pick_node_locked(self, rec: TaskRecord) -> Optional[NodeState]:
+    # Sentinel for _pick_node_locked's pref parameter: "not computed yet"
+    # (None is a valid computed preference).
+    _PREF_UNSET = object()
+
+    def _pick_node_locked(self, rec: TaskRecord,
+                          pref=_PREF_UNSET) -> Optional[NodeState]:
         """Hybrid policy condensed (reference:
         scheduling/policy/hybrid_scheduling_policy.cc — prefer local until
         threshold, then best remote; spillback)."""
@@ -1424,14 +1449,29 @@ class Runtime:
             else:
                 return None
         if strategy and strategy[0] == "spread":
-            candidates = [self.nodes[nid] for nid in self.node_order
-                          if self.nodes[nid].alive
-                          and self.nodes[nid].can_fit(rec.requirements)]
-            if candidates:
-                return max(candidates, key=lambda n: sum(
-                    n.available.get(k, 0) / max(n.resources.get(k, 1), 1)
-                    for k in rec.requirements))
-            return None
+            best = None
+            best_score = 0.0
+            for nid in self.node_order:
+                node = self.nodes[nid]
+                if not node.alive or not node.can_fit(rec.requirements):
+                    continue
+                score = sum(
+                    node.available.get(k, 0) / max(node.resources.get(k, 1),
+                                                   1)
+                    for k in rec.requirements)
+                # Strictly-greater with an epsilon: float near-ties (and
+                # exact ties) resolve to the earliest node in node_order,
+                # so spread placement is deterministic and testable.
+                if best is None or score > best_score + 1e-9:
+                    best, best_score = node, score
+            return best
+        if pref is self._PREF_UNSET:
+            pref = self._locality_pref_locked(rec)
+        if pref is not None and pref[0].can_fit(rec.requirements):
+            # Top-locality node has fresh capacity: place there.  The
+            # hit/miss/bytes accounting happens at the dispatch site,
+            # which also covers the pipelined-lease placements.
+            return pref[0]
         head = self.nodes[self.node_order[0]]
         if head.alive and head.can_fit(rec.requirements):
             return head
@@ -1440,6 +1480,56 @@ class Runtime:
             if node.alive and node.can_fit(rec.requirements):
                 return node
         return None
+
+    def _node_for_store_locked(self, store_hex: str) -> Optional[NodeState]:
+        """The node whose object store is ``store_hex`` (in-process test
+        nodes share the head's store and map to the head node)."""
+        if store_hex == self.store_id:
+            return self.head_node
+        agent = self._agents.get(store_hex)
+        return agent.node if agent is not None and not agent.dead else None
+
+    def _locality_pref_locked(
+            self, rec: TaskRecord) -> Optional[Tuple[NodeState, int]]:
+        """(top-locality node, argument bytes homed there), or None when
+        locality does not apply — strategy/PG tasks, no sizeable homed
+        args, or the feature switched off.  Walks the spec's arg/kwarg
+        descriptors once per record: every SHM/SPILLED descriptor carries
+        (size, home store_id), and a "ref" arg's descriptor is READY in
+        the object table by pick time (deps resolved before enqueue).
+        Reference: locality-aware lease selection in
+        hybrid_scheduling_policy.cc via the owner's object directory
+        (the head IS the directory here — Ownership, NSDI'21)."""
+        if not self.config.locality_scheduling:
+            return None
+        if rec.pg_id is not None or rec.spec.get("scheduling_strategy"):
+            return None
+        homes = rec.locality_homes
+        if homes is None:
+            homes = {}
+            spec = rec.spec
+            for d in itertools.chain(spec.get("args", ()),
+                                     (spec.get("kwargs") or {}).values()):
+                if d and d[0] == "ref":
+                    st = self.objects.get(ObjectID(d[1]))
+                    d = st.descr if st is not None else None
+                if (d is not None and len(d) > 3
+                        and d[0] in (protocol.SHM, protocol.SPILLED)):
+                    homes[d[3]] = homes.get(d[3], 0) + d[2]
+            rec.locality_homes = homes
+        if not homes:
+            return None
+        best = None
+        best_bytes = 0
+        for store, nbytes in homes.items():
+            if nbytes < best_bytes or nbytes < self.config.locality_min_bytes:
+                continue
+            node = self._node_for_store_locked(store)
+            if node is None or not node.alive:
+                continue
+            if best is None or nbytes > best_bytes:
+                best, best_bytes = node, nbytes
+        return None if best is None else (best, best_bytes)
 
     def _lend_node_locked(self, rec: "TaskRecord") -> Optional[NodeState]:
         """Over-capacity admission backed by BLOCKED workers — without
@@ -1531,21 +1621,39 @@ class Runtime:
                 if rec.cancelled or rec.dispatched:
                     q.popleft()
                     continue
-                node = self._pick_node_locked(rec)
+                pref = self._locality_pref_locked(rec)
+                node = self._pick_node_locked(rec, pref)
+                worker = None
                 if node is None:
                     # No free capacity: overflow onto existing leases
                     # (pipelining) rather than stall the class.  Fresh
                     # capacity is preferred so a long task can't head-of-
-                    # line-block a short one while CPUs sit idle.
-                    worker = self._find_pipelinable_worker_locked(key)
-                    if worker is not None:
-                        q.popleft()
-                        self._assign_to_worker_locked(worker, rec)
-                        continue
-                    # Last resort: blocked workers lend their slots.
-                    node = self._lend_node_locked(rec)
-                    if node is None:
-                        break   # same class behind it cannot place either
+                    # line-block a short one while CPUs sit idle.  With a
+                    # locality preference, a lease on the preferred node
+                    # wins among the pipelinable candidates.
+                    worker = self._find_pipelinable_worker_locked(
+                        key, prefer_node=(pref[0] if pref else None))
+                    if worker is None:
+                        # Last resort: blocked workers lend their slots.
+                        node = self._lend_node_locked(rec)
+                        if node is None:
+                            break  # same class behind cannot place either
+                elif pref is not None and node is not pref[0]:
+                    # Fresh capacity only AWAY from the argument bytes: a
+                    # pipelinable leased worker already on the top-
+                    # locality node beats it (the lease holds the
+                    # resources there and the args need no transfer) —
+                    # but only up to the pipeline depth cap; past it the
+                    # fresh node wins (locality must never stall a class).
+                    w = self._find_pipelinable_worker_locked(
+                        key, prefer_node=pref[0])
+                    if w is not None and w.node is pref[0]:
+                        worker = w
+                if worker is not None:
+                    q.popleft()
+                    self._count_locality_locked(pref, worker.node, rec)
+                    self._assign_to_worker_locked(worker, rec)
+                    continue
                 use_pg = rec.pg_id is not None
                 if use_pg:
                     pg = self.placement_groups.get(rec.pg_id)
@@ -1567,6 +1675,7 @@ class Runtime:
                     tpu_chips = node.tpu_free[:n_tpu]
                     node.tpu_free = node.tpu_free[n_tpu:]
                 q.popleft()
+                self._count_locality_locked(pref, node, rec)
                 rec.node = node
                 worker = self._lease_worker_locked(node, rec, tpu_chips)
                 worker.lease_req = dict(rec.requirements)
@@ -1583,21 +1692,65 @@ class Runtime:
                 self.pending_tasks.pop(key, None)
         self._service_client_leases_locked()
 
+    def _count_locality_locked(self, pref, target: NodeState,
+                               rec: TaskRecord):
+        """Account one placement against its locality preference — at the
+        dispatch commit point only, so an aborted placement attempt (TPU
+        chips mid-retire) can't double-count on the retry pass.
+
+        A hit is credited only when locality actually CHANGED the
+        placement: landing on the preferred node when the head-first
+        default would have picked it anyway (e.g. head-homed args on a
+        single-node cluster, where no byte could ever cross the network)
+        counts nothing, so locality_bytes_saved reflects genuinely
+        avoided transfers."""
+        if pref is None:
+            return
+        if target is not pref[0]:
+            self.locality_misses += 1
+            return
+        default = None
+        alive = 0
+        for nid in self.node_order:
+            node = self.nodes[nid]
+            if not node.alive:
+                continue
+            alive += 1
+            if default is None and node.can_fit(rec.requirements):
+                default = node
+        if alive < 2 or default is target:
+            return  # placement could not have / did not change
+        self.locality_hits += 1
+        self.locality_bytes_saved += pref[1]
+
     def _find_pipelinable_worker_locked(
-            self, key: tuple) -> Optional[WorkerHandle]:
+            self, key: tuple,
+            prefer_node: Optional[NodeState] = None
+    ) -> Optional[WorkerHandle]:
+        """Least-loaded leased worker of the class with pipeline room.
+        ``prefer_node`` (locality): a candidate on that node wins over a
+        less-loaded one elsewhere, but NEVER past the depth cap — the
+        cap bounds head-of-line blocking and locality must not bypass
+        it."""
         lst = self.leased_workers.get(key)
         if not lst:
             return None
         depth = self.config.max_tasks_in_flight_per_worker
         best = None
+        best_pref = None
         for w in lst:
             if w.dead or w.blocked or w.released or w.actor_id is not None \
                     or w.pending_force_kill is not None:
                 continue
-            if len(w.inflight) < depth and (
-                    best is None or len(w.inflight) < len(best.inflight)):
+            if len(w.inflight) >= depth:
+                continue
+            if best is None or len(w.inflight) < len(best.inflight):
                 best = w
-        return best
+            if prefer_node is not None and w.node is prefer_node and (
+                    best_pref is None
+                    or len(w.inflight) < len(best_pref.inflight)):
+                best_pref = w
+        return best_pref if best_pref is not None else best
 
     def _assign_to_worker_locked(self, worker: WorkerHandle,
                                  rec: TaskRecord):
@@ -1784,6 +1937,8 @@ class Runtime:
             "RAY_TPU_OBJECT_POOL_SIZE": str(self.config.object_pool_size),
             "RAY_TPU_OBJECT_STRIPE_THRESHOLD":
                 str(self.config.object_stripe_threshold),
+            "RAY_TPU_ARG_PREFETCH_DEPTH":
+                str(self.config.arg_prefetch_depth),
         })
         env["RAY_TPU_STORE_ID"] = self.store_id
         # Worker output goes to a per-worker file (reference: workers log
@@ -1838,6 +1993,8 @@ class Runtime:
             "RAY_TPU_OBJECT_POOL_SIZE": str(self.config.object_pool_size),
             "RAY_TPU_OBJECT_STRIPE_THRESHOLD":
                 str(self.config.object_stripe_threshold),
+            "RAY_TPU_ARG_PREFETCH_DEPTH":
+                str(self.config.arg_prefetch_depth),
         })
         w = WorkerHandle(worker_id, None, None, node, env_key, tpu_chips)
         node.all_workers[id(w)] = w
@@ -2743,6 +2900,16 @@ class Runtime:
             with self.lock:
                 self.events.setdefault(msg[1], deque(maxlen=10000)).append(
                     msg[2])
+        elif tag == "xfer_stats":
+            # Periodic data-plane counter DELTAS from a worker (pull
+            # dedup, argument-prefetch hit/waste bytes) — aggregated
+            # here next to brokered_parts/relayed_segments.
+            with self.lock:
+                d = msg[1]
+                self.deduped_pulls += d.get("deduped_pulls", 0)
+                self.prefetch_hit_bytes += d.get("prefetch_hit_bytes", 0)
+                self.prefetch_waste_bytes += d.get(
+                    "prefetch_waste_bytes", 0)
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
         elif tag == "result_batch":
@@ -3995,6 +4162,8 @@ class Runtime:
                         out.append({"worker_id": wid,
                                     "lines": list(ring)[-tail:]})
             return out[:limit]
+        if kind == "transfer_stats":
+            return [self.transfer_stats()]
         if kind == "handler_stats":
             with self._handler_stats_lock:
                 return [{
@@ -4005,6 +4174,22 @@ class Runtime:
                 } for tag, s in sorted(self._handler_stats.items(),
                                        key=lambda kv: -kv[1][1])][:limit]
         raise ValueError(f"unknown state query kind {kind!r}")
+
+    def transfer_stats(self) -> Dict[str, int]:
+        """Data-plane + locality counters in one snapshot: the scheduler's
+        locality accounting plus the aggregated worker-side prefetch/
+        dedup deltas, next to the head's own relay fallbacks."""
+        with self.lock:
+            return {
+                "locality_hits": self.locality_hits,
+                "locality_misses": self.locality_misses,
+                "locality_bytes_saved": self.locality_bytes_saved,
+                "prefetch_hit_bytes": self.prefetch_hit_bytes,
+                "prefetch_waste_bytes": self.prefetch_waste_bytes,
+                "deduped_pulls": self.deduped_pulls,
+                "brokered_parts": self.brokered_parts,
+                "relayed_segments": self.relayed_segments,
+            }
 
     def list_nodes(self):
         with self.lock:

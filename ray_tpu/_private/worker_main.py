@@ -101,6 +101,24 @@ class _WorkerRuntime:
         # server; misses are never cached (a recovering peer gets its
         # fast path back on the next pull).
         self._store_addrs: Dict[str, Any] = {}
+        # Singleflight registry for remote-segment pulls: N concurrent
+        # materializations of one segment (prefetcher + executing tasks)
+        # share one pull; prefetched segments are retained here until
+        # _load_args consumes them (reference: the raylet's pull-manager
+        # dedup + dependency prefetch).
+        self._pull_registry = object_transfer.PullRegistry()
+        self._xfer_sent: Dict[str, int] = {}
+        self._xfer_lock = threading.Lock()
+        self.arg_prefetch_depth = int(
+            os.environ.get("RAY_TPU_ARG_PREFETCH_DEPTH", "2") or 0)
+        self.prefetcher = _ArgPrefetcher(self, self.arg_prefetch_depth)
+        # Tasks currently inside _execute (heuristic for "a task is
+        # running, queued work is BEHIND it" — the prefetch condition).
+        # Lock-guarded updates: threaded actors (max_concurrency > 1)
+        # run _execute concurrently, and a lost increment/decrement
+        # would wedge the counter (and the prefetch heuristic) forever.
+        self._executing = 0
+        self._exec_lock = threading.Lock()
         # Completed-task results buffered between queue drains: back-to-
         # back short tasks ride to the driver as ONE result_batch message
         # (reference: batched reply streams; kills per-task head wakeups).
@@ -253,6 +271,22 @@ class _WorkerRuntime:
             buf, self._span_buf = self._span_buf, []
         self._send(("spans", buf))
 
+    def flush_xfer_stats(self):
+        """Ship data-plane counter deltas (pull dedup, prefetch hit/waste
+        bytes) to the head, which aggregates them next to its
+        brokered_parts/relayed_segments stats.  Rides the periodic
+        flusher and the queue-drain flush; no-delta calls send nothing.
+        The claim (delta + baseline update) is atomic under _xfer_lock —
+        two concurrent flushers must never report the same delta twice."""
+        with self._xfer_lock:
+            cur = self._pull_registry.stats()
+            delta = {k: v - self._xfer_sent.get(k, 0)
+                     for k, v in cur.items()}
+            if not any(delta.values()):
+                return
+            self._xfer_sent = cur
+        self._send(("xfer_stats", delta))
+
     def flush_decrefs(self):
         head_bins = self._drain_decrefs()
         abuf = self._drain_actor_decrefs()
@@ -388,6 +422,57 @@ class _WorkerRuntime:
         raise ValueError(f"bad descriptor {descr!r}")
 
     def _direct_pull(self, descr):
+        seg = self._pull_remote_segment(descr)
+        if seg is None:
+            return _PULL_MISS
+        try:
+            meta, bufs = seg.raw_parts()
+            return serialization.loads(meta, bufs)
+        except Exception:
+            # Corrupt/truncated receive: the brokered getparts path
+            # re-fetches through the owner (and drives recovery).
+            return _PULL_MISS
+
+    def _pull_remote_segment(self, descr, prefetch: bool = False):
+        """Singleflight pull of a remote SHM segment into a local read
+        Segment (one copy, socket -> mapping).  Concurrent callers for
+        the same segment share the leader's pull; a retained prefetched
+        segment is consumed directly.  Returns None on any failure — the
+        caller falls back to the brokered getparts path (which also
+        drives recovery), and a failed leader wakes every waiter into
+        that same fallback."""
+        key = (descr[3], descr[1])
+        reg = self._pull_registry
+        for _attempt in range(2):
+            ent, leader = reg.begin(key, prefetch=prefetch)
+            if leader:
+                seg = None
+                try:
+                    seg = self._pull_segment_once(descr)
+                finally:
+                    # Publish under all circumstances (incl. an
+                    # unexpected raise): waiters must never hang on a
+                    # dead leader.
+                    reg.finish(key, ent, seg,
+                               retain=prefetch and seg is not None)
+                return seg
+            if prefetch:
+                return None  # already in flight or retained: nothing to do
+            if not ent.event.is_set():
+                ent.wait()
+            seg = reg.take(key, ent)
+            if seg is not None or ent.failed:
+                # A failed leader means the pull path itself is broken:
+                # fall back (getparts relay) rather than retry in place.
+                return seg
+            # Retention evicted the segment between begin() and take():
+            # loop once more and re-pull directly as a fresh leader.
+        return None
+
+    def _pull_segment_once(self, descr):
+        """One actual pull attempt (address resolution + chunk stream);
+        returns None instead of raising so singleflight failure wakes
+        waiters into their own fallback."""
         store = descr[3]
         ent = self._store_addrs.get(store)
         if ent is None:
@@ -405,23 +490,21 @@ class _WorkerRuntime:
                 # recovered peer gets its fast path back.  The relay
                 # fallback this returns into is far costlier than the
                 # one extra location lookup.
-                return _PULL_MISS
+                return None
             ent = self._store_addrs[store] = (addr, caps)
         addr, caps = ent
         try:
             # One-copy receive: chunks land straight in a local shm
             # mapping; deserialization builds zero-copy views over it
             # (the value's arrays keep the mapping alive).
-            seg = object_transfer.pull_to_segment(
+            return object_transfer.pull_to_segment(
                 self._puller, self.shm, store, addr, descr[1], caps=caps)
-            meta, bufs = seg.raw_parts()
-            return serialization.loads(meta, bufs)
         except Exception:
             # Agent gone or segment moved: the owner knows the truth —
             # fall back to the brokered path (which also drives recovery).
             # Forget the cached address so a restarted peer re-resolves.
             self._store_addrs.pop(store, None)
-            return _PULL_MISS
+            return None
 
     def serialize_value(self, value: Any, object_id: ObjectID):
         """Value -> descriptor, choosing inline vs shm by size (one
@@ -759,6 +842,82 @@ class _WorkerRuntime:
 
 _PULL_MISS = object()
 
+
+def _iter_remote_shm_descrs(rt: "_WorkerRuntime", task: dict):
+    """The task's arg/kwarg descriptors that live in ANOTHER node's
+    store — the ones whose materialization pays a network pull."""
+    for d in itertools.chain(task.get("args", ()),
+                             (task.get("kwargs") or {}).values()):
+        if (isinstance(d, tuple) and d and d[0] == protocol.SHM
+                and len(d) > 3 and d[3] != rt.store_id):
+            yield d
+
+
+class _ArgPrefetcher:
+    """Pulls the remote SHM args of QUEUED tasks while the current task
+    computes, so transfer overlaps compute instead of sitting on the
+    task's critical path (reference: the raylet pulls task dependencies
+    before the worker starts — dependency_manager.h).
+
+    At most ``depth`` pulls are in flight (one per lazily-started worker
+    thread); results land in the runtime's singleflight PullRegistry as
+    RETAINED segments that ``_load_args`` consumes.  Everything is
+    best-effort: a failed prefetch just leaves the task's own load path
+    to do the pull (or fall back to the head relay)."""
+
+    def __init__(self, rt: "_WorkerRuntime", depth: int):
+        self._rt = rt
+        self._depth = depth
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads = 0
+        self._lock = threading.Lock()
+        # Keys queued but not yet processed: duplicate offers of one
+        # segment (enqueue-time hook + _load_args, or N queued tasks
+        # sharing an arg) collapse to one queue entry instead of N
+        # stale items that could re-pull after the segment is consumed.
+        self._queued: set = set()
+
+    def offer(self, task: dict):
+        """Queue the task's remote args for background pulling."""
+        self.offer_descrs(_iter_remote_shm_descrs(self._rt, task))
+
+    def offer_descrs(self, descrs):
+        if self._depth <= 0:
+            return
+        for d in descrs:
+            if d[2] > object_transfer.PullRegistry.RETAIN_BYTES:
+                # Larger than the retention budget: finish(retain=True)
+                # would immediately self-evict it, so a prefetch pull
+                # would be pure double transfer — let the task's own
+                # load path stream it once.
+                continue
+            key = (d[3], d[1])
+            with self._lock:
+                if key in self._queued:
+                    continue
+                self._queued.add(key)
+            self._q.put(d)
+            self._ensure_thread()
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._threads >= self._depth:
+                return
+            self._threads += 1
+        threading.Thread(target=self._loop, daemon=True,
+                         name="ray_tpu-arg-prefetch").start()
+
+    def _loop(self):
+        while True:
+            d = self._q.get()
+            with self._lock:
+                self._queued.discard((d[3], d[1]))
+            try:
+                self._rt._pull_remote_segment(d, prefetch=True)
+            except Exception:
+                pass  # best-effort; the task's own load path recovers
+
+
 _runtime: Optional[_WorkerRuntime] = None
 
 
@@ -800,6 +959,8 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
     num_returns = task["num_returns"]
     name = task.get("name", "task")
     span_start = _time.time()
+    with rt._exec_lock:
+        rt._executing += 1
     try:
         args, kwargs = _load_args(rt, task)
         if "actor_id" in task:
@@ -839,6 +1000,8 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
         else:
             rt.send_result((task["task_id"], False, returns, {}))
     finally:
+        with rt._exec_lock:
+            rt._executing -= 1
         rt.current_task_id = None
         rt.current_actor_id = None
         rt.record_span(task["task_id"], name, span_start, _time.time(),
@@ -860,6 +1023,22 @@ def _pickle_error(err):
 
 
 def _load_args(rt: _WorkerRuntime, task: dict):
+    """Materialize the task's arguments.  Remote SHM args are pulled
+    CONCURRENTLY (bounded by arg_prefetch_depth helper threads) instead
+    of one blocking stream at a time; materialize() below then consumes
+    the pulled segments through the singleflight registry — which also
+    makes this a no-op for anything the prefetcher already fetched."""
+    depth = getattr(rt, "arg_prefetch_depth", 0)
+    if depth > 0:
+        remote: Dict[tuple, tuple] = {}
+        for d in _iter_remote_shm_descrs(rt, task):
+            remote.setdefault((d[3], d[1]), d)
+        if len(remote) > 1:
+            # The first remote arg streams on THIS thread (inside
+            # materialize); the prefetcher's bounded thread pool pulls
+            # the rest in parallel — materialize() consumes them through
+            # the singleflight registry as they land.
+            rt.prefetcher.offer_descrs(list(remote.values())[1:])
     args = [rt.materialize(d) for d in task["args"]]
     kwargs = {k: rt.materialize(d) for k, d in task.get("kwargs", {}).items()}
     return args, kwargs
@@ -1063,8 +1242,15 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
         tag = msg[0]
         if tag in ("exec", "create_actor", "kill"):
             with tq_cv:
+                queued_behind = bool(tasks) or rt._executing > 0
                 tasks.append(msg)
                 tq_cv.notify()
+            if tag == "exec" and queued_behind:
+                # The task landed BEHIND running/queued work: start
+                # pulling its remote args now so transfer overlaps the
+                # compute ahead of it (the prefetcher is a no-op for
+                # local/inline args and when depth is 0).
+                rt.prefetcher.offer(msg[1])
         elif tag == "batch" or tag == "msg_batch":
             # Wire-batch envelope (or the legacy conflation-sender
             # spelling): a burst of buffered messages in send order.
@@ -1115,10 +1301,20 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
             tasks.append(("exec", task))
             tq_cv.notify()
 
+    def maybe_prefetch(task: dict):
+        # DirectServer calls this BEFORE enqueueing each pushed task:
+        # when the task will land behind running/queued work, its remote
+        # args start pulling while that work computes.
+        with tq_cv:
+            busy = bool(tasks) or rt._executing > 0
+        if busy:
+            rt.prefetcher.offer(task)
+
     direct_server = direct_mod.DirectServer(
         bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")),
         direct_enqueue, fns.put, rt.shm.unlink,
-        on_peer_msg=rt.dispatch_peer_msg, queue_empty=_queue_empty)
+        on_peer_msg=rt.dispatch_peer_msg, queue_empty=_queue_empty,
+        on_task_queued=maybe_prefetch)
     rt.direct_addr = direct_server.address
 
     def decref_flusher():
@@ -1132,6 +1328,8 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                 # buffered short-task results.
                 rt.flush_results()
                 rt.flush_spans()
+                rt._pull_registry.sweep()
+                rt.flush_xfer_stats()
                 direct_server.flush_replies()
             except Exception:
                 return  # conn gone; reader exits the process
@@ -1156,6 +1354,7 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
             # before this worker parks.  Outside tq_cv: the flushes take
             # send locks and must not hold up direct enqueues.
             rt.flush_results()
+            rt.flush_xfer_stats()
             direct_server.flush_replies()
         with tq_cv:
             while not tasks:

@@ -94,8 +94,17 @@ def _ring_body(q, sm_scale, causal, axis_name, n, my_idx):
     return step
 
 
+def _axis_size(axis_name: str) -> int:
+    """jax.lax.axis_size, with the 0.4.x fallback (the axis env frame)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    size = jax.core.axis_frame(axis_name)  # 0.4.x: the size itself
+    return getattr(size, "size", size)
+
+
 def _ring_attention_sharded(q, k, v, sm_scale, causal, axis_name):
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, sq, h, _ = q.shape
     m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)

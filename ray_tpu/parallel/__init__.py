@@ -25,6 +25,7 @@ from ray_tpu.parallel.mesh import (
     MESH_AXES,
     MeshConfig,
     make_mesh,
+    use_mesh,
 )
 from ray_tpu.parallel.sharding import (
     LogicalAxisRules,
@@ -37,7 +38,7 @@ from ray_tpu.parallel.sharding import (
 
 __all__ = [
     "AXIS_DP", "AXIS_FSDP", "AXIS_EP", "AXIS_PP", "AXIS_SP", "AXIS_TP",
-    "MESH_AXES", "MeshConfig", "make_mesh",
+    "MESH_AXES", "MeshConfig", "make_mesh", "use_mesh",
     "LogicalAxisRules", "DEFAULT_RULES", "logical_to_mesh_axes",
     "named_sharding", "shard_pytree", "with_logical_constraint",
 ]

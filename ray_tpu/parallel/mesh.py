@@ -101,21 +101,50 @@ def make_mesh(config: Optional[MeshConfig] = None,
     Axes are ``Auto`` (GSPMD propagation): model code steers the partitioner
     with ``with_sharding_constraint`` rather than jax 0.9's explicit
     sharding-in-types mode, which would demand out_shardings on every
-    ambiguous op (gathers, einsums) throughout model code.
+    ambiguous op (gathers, einsums) throughout model code.  On jax
+    releases predating ``jax.sharding.AxisType`` (<= 0.4.x) every axis is
+    implicitly Auto, so the kwarg is simply omitted — feature-detected,
+    since passing it would raise (AttributeError here, TypeError inside
+    ``jax.make_mesh``).
     """
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
     sizes = config.sizes(len(devices))
-    auto = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    kwargs = ({"axis_types": (axis_type.Auto,) * len(MESH_AXES)}
+              if axis_type is not None else {})
     try:
-        return jax.make_mesh(sizes, MESH_AXES, devices=devices,
-                             axis_types=auto)
+        try:
+            return jax.make_mesh(sizes, MESH_AXES, devices=devices,
+                                 **kwargs)
+        except TypeError:
+            if not kwargs:
+                raise
+            # jax.make_mesh exists but predates the axis_types kwarg.
+            kwargs = {}
+            return jax.make_mesh(sizes, MESH_AXES, devices=devices)
     except (ValueError, NotImplementedError):
         # jax.make_mesh's contiguous-remapping can reject exotic topologies;
         # fall back to a plain row-major reshape.
         arr = np.asarray(devices).reshape(sizes)
-        return jax.sharding.Mesh(arr, MESH_AXES, axis_types=auto)
+        try:
+            return jax.sharding.Mesh(arr, MESH_AXES, **kwargs)
+        except TypeError:
+            return jax.sharding.Mesh(arr, MESH_AXES)
 
 
 def mesh_axis_size(mesh: jax.sharding.Mesh, axis: str) -> int:
     return mesh.shape[axis]
+
+
+def use_mesh(mesh: jax.sharding.Mesh):
+    """Activate ``mesh`` as the ambient mesh, as a context manager.
+
+    On current jax this is ``jax.set_mesh``; releases predating it
+    (<= 0.4.x) get the classic ``Mesh`` context manager, which sets the
+    thread-resource physical mesh that pjit/shard_map resolve against —
+    the same role."""
+    setter = getattr(jax, "set_mesh", None)
+    if setter is not None:
+        return setter(mesh)
+    return mesh

@@ -275,6 +275,54 @@ def test_object_puller_lock_order_convention(checker, monkeypatch):
     puller.close()
 
 
+def test_pull_registry_lock_order_convention(checker):
+    """object_transfer.PullRegistry's documented convention: the registry
+    ``_lock`` is an INDEPENDENT LEAF — never held across a dial, stream
+    I/O or an event wait, and NO other lock is acquired under it (note
+    Event.set acquires the event's internal condition lock, so finish()
+    must — and does — set outside ``_lock``).  The recorded acquisition
+    graph must show zero outgoing edges from the registry lock across
+    the leader/waiter/retain/consume/failure paths."""
+    from ray_tpu._private.object_transfer import PullRegistry
+
+    class _Seg:
+        size = 7
+
+        def close(self):
+            pass
+
+    reg = PullRegistry()
+    assert isinstance(reg._lock, lockcheck._LockProxy)
+    # Leader + concurrent waiter sharing its result.
+    ent, leader = reg.begin(("s", "a"))
+    assert leader
+    got = []
+    waiter = threading.Thread(target=lambda: got.append(ent.wait(5)))
+    waiter.start()
+    seg = _Seg()
+    reg.finish(("s", "a"), ent, seg)
+    waiter.join(timeout=5)
+    assert got == [seg]
+    assert reg.deduped_pulls == 0  # the waiter attached via wait(), not begin
+    # Prefetch retention + consume.
+    pent, pleader = reg.begin(("s", "b"), prefetch=True)
+    assert pleader
+    reg.finish(("s", "b"), pent, _Seg(), retain=True)
+    cent, cleader = reg.begin(("s", "b"))
+    assert not cleader and reg.take(("s", "b"), cent) is pent.seg
+    # Failure path wakes into the fallback.
+    fent, fleader = reg.begin(("s", "c"))
+    assert fleader
+    reg.finish(("s", "c"), fent, None)
+    assert fent.wait(1) is None
+    registry_site = reg._lock._site
+    edges = checker.edges()
+    assert edges.get(registry_site, set()) == set(), (
+        f"a lock was acquired while holding the pull-registry lock: "
+        f"{edges.get(registry_site)}")
+    checker.assert_acyclic()
+
+
 def test_shm_store_copy_pool_lock_convention(checker, monkeypatch,
                                              tmp_path):
     """shm_store's documented convention: the module copy-pool lock and

@@ -13,7 +13,8 @@ from ray_tpu.models import (
     LlamaConfig, init_params, forward, loss_fn, param_logical_axes,
 )
 from ray_tpu.models.llama import forward_pipelined
-from ray_tpu.parallel import MeshConfig, make_mesh, shard_pytree
+from ray_tpu.parallel import (MeshConfig, make_mesh, shard_pytree,
+                              use_mesh)
 from ray_tpu.train import TrainState, init_train_state, make_train_step
 
 
@@ -40,7 +41,7 @@ def test_sharded_loss_matches_single_device(name, cfg_kw, mesh_kw):
     batch = _batch(cfg)
     ref, _ = loss_fn(params, batch, cfg)
     mesh = make_mesh(MeshConfig(**mesh_kw))
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         sp = shard_pytree(params, param_logical_axes(cfg), mesh)
         toks = jax.device_put(
             batch["tokens"], NamedSharding(mesh, P(("dp", "fsdp"), None)))
@@ -58,7 +59,7 @@ def test_pipelined_forward_matches(attn):
     ref_logits, _ = forward(params, toks, cfg)
     mesh = make_mesh(MeshConfig(dp=2, pp=2, sp=2 if attn == "ring" else 1,
                                 tp=1 if attn == "ring" else 2))
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         sp = shard_pytree(params, param_logical_axes(cfg), mesh)
         ts = jax.device_put(toks, NamedSharding(mesh, P(("dp", "fsdp"),
                                                         None)))
@@ -89,7 +90,7 @@ def test_train_step_sharded_matches_single_device():
     s1, m1 = step(state, batch)
 
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state_sh = init_train_state(KEY, cfg, opt, mesh=mesh)
         step_sh = make_train_step(cfg, opt, mesh=mesh, donate=False)
         toks = jax.device_put(

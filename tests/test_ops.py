@@ -15,7 +15,7 @@ from ray_tpu.ops import (
     rms_norm, rope, apply_rope,
 )
 from ray_tpu.ops.moe import moe_ffn
-from ray_tpu.parallel import MeshConfig, make_mesh
+from ray_tpu.parallel import MeshConfig, make_mesh, use_mesh
 
 B, S, H, D = 2, 128, 4, 32
 
@@ -69,7 +69,7 @@ def test_sequence_parallel_attention(qkv, impl, causal):
     fn = ring_attention if impl == "ring" else ulysses_attention
     kw = {} if impl == "ring" else {"use_flash": False}
     ref = mha_reference(q, k, v, causal=causal)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         out = fn(qs, ks, vs, causal=causal, mesh=mesh, **kw)
         assert jnp.max(jnp.abs(out - ref)) < 1e-4
         # grads through the ring/all-to-all
