@@ -400,6 +400,80 @@ def locality_bench():
     return out
 
 
+def data_streaming_bench():
+    """ray_tpu.data streaming-engine row: a fixed 3-stage paced pipeline
+    (fused chain, 2 MB output blocks) run with the operator-graph
+    executor on vs the legacy windowed path — rows/s and the engine's
+    peak in-flight bytes, so the backpressured engine's admission win
+    (bytes-budgeted, cluster-wide — vs the legacy 8-chain count window)
+    and any regression stay visible in the round trajectory.  Stages are
+    paced with sleeps at num_cpus=0 so the comparison measures engine
+    structure, not host load."""
+    import numpy as np
+
+    import ray_tpu as ray
+    from ray_tpu import data as rd
+
+    n_blocks, rows_per_block = 32, 64
+    blk = 2 << 20
+
+    def build():
+        def inflate(b):
+            time.sleep(0.04)
+            return {"x": np.zeros(blk // 8, np.float64)}
+
+        def scale(b):
+            time.sleep(0.02)
+            return {"x": b["x"] + 1.0}
+
+        def mark(b):
+            time.sleep(0.02)
+            return {"x": -b["x"]}
+
+        return (rd.from_items(list(range(n_blocks * rows_per_block)),
+                              parallelism=n_blocks)
+                .map_batches(inflate, num_cpus=0)
+                .map_batches(scale, num_cpus=0)
+                .map_batches(mark, num_cpus=0))
+
+    def run(streaming):
+        sc = None if streaming else {"streaming_executor": False}
+        ray.init(num_cpus=16, _system_config=sc)
+        def consume(ds):
+            # Consumption path (iter_batches, zero-copy whole blocks):
+            # this is where the legacy path's 8-chain window binds
+            # (materialize() opens the legacy window fully and would
+            # measure nothing).
+            for _ in ds.iter_batches(batch_size=None):
+                pass
+
+        try:
+            consume(build())        # warm the worker pool
+            t0 = time.perf_counter()
+            ds = build()
+            consume(ds)
+            dt = time.perf_counter() - t0
+            s = ds._stats.streaming_summary()
+            return {
+                "rows_per_s": round(n_blocks * rows_per_block / dt, 1),
+                "wall_s": round(dt, 3),
+                "peak_inflight_bytes": s["peak_inflight_bytes"],
+                "admitted_tasks": s["admitted_tasks"],
+                "backpressure_stalls": s["backpressure_stalls"],
+            }
+        finally:
+            ray.shutdown()
+
+    out = {"n_blocks": n_blocks, "block_mb": blk >> 20,
+           "streaming_on": run(True), "streaming_off": run(False)}
+    print(f"  [data_streaming] on: {out['streaming_on']['rows_per_s']} "
+          f"rows/s, peak "
+          f"{out['streaming_on']['peak_inflight_bytes'] >> 20} MB "
+          f"in-flight; off: {out['streaming_off']['rows_per_s']} rows/s",
+          file=sys.stderr)
+    return out
+
+
 # Peak bf16 FLOP/s by device kind (for MFU).
 _PEAK_FLOPS = {
     "TPU v4": 275e12,
@@ -616,6 +690,12 @@ def main():
         locality = {"error": repr(e)}
 
     try:
+        data_streaming = data_streaming_bench()
+    except Exception as e:  # noqa: BLE001 — extra row must not kill core
+        print(f"  [data_streaming] bench failed: {e!r}", file=sys.stderr)
+        data_streaming = {"error": repr(e)}
+
+    try:
         tpu = tpu_bench()
     except Exception as e:  # noqa: BLE001 — device bench must not kill core
         print(f"  [tpu] device bench failed: {e!r}", file=sys.stderr)
@@ -629,6 +709,7 @@ def main():
         "geomean_wins_capped_at_4x": round(geo_capped, 4),
         "non_comparable": extras,
         "arg_locality": locality,
+        "data_streaming": data_streaming,
         "tpu": tpu,
     }))
 

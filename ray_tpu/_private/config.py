@@ -92,6 +92,25 @@ class Config:
     # task's critical path, the pre-PR behavior).
     arg_prefetch_depth: int = 2
 
+    # --- ray_tpu.data streaming execution (reference:
+    # python/ray/data/_internal/execution/streaming_executor.py — operator
+    # graph with resource-budgeted admission). ---
+    # Master switch for the backpressured operator-graph executor behind
+    # Dataset._stream_refs.  Off = the pre-PR windowed chain-submission
+    # path, byte-identical, with every streaming counter zero.
+    streaming_executor: bool = True
+    # Global in-flight byte budget for a streaming execution: queued
+    # intermediate blocks + estimated in-flight task output.  0 = auto,
+    # data_memory_budget_fraction of the object-store capacity (the
+    # store's configured cap, else the shm filesystem size).
+    data_memory_budget: int = 0
+    data_memory_budget_fraction: float = 0.25
+    # Cap on concurrently in-flight streaming tasks across all operators
+    # (admission is primarily byte-budgeted; this bounds task/worker
+    # fan-out for tiny-block datasets).  0 = auto: the cluster's total
+    # CPU count (min 1, fallback 8 when it cannot be read).
+    data_max_inflight_tasks: int = 0
+
     # Seconds a worker may sit idle before the pool reaps it (reference:
     # idle worker killing in worker_pool.cc).
     idle_worker_timeout_s: float = 300.0

@@ -1871,6 +1871,31 @@ class Runtime:
             return w
         return self._spawn_worker(node, env_key, rec, tpu_chips)
 
+    def _worker_config_env(self) -> Dict[str, str]:
+        """Config knobs that follow _system_config overrides into workers
+        via the env namespace (worker GLOBAL_CONFIG is rebuilt from env at
+        import).  Shared by both spawn paths so a knob added here reaches
+        agent-spawned workers too — the ray_tpu.data entries are what lets
+        a Dataset consumed INSIDE a worker (the Train shard contract) see
+        the driver's engine switch and byte budget."""
+        return {
+            "RAY_TPU_MAX_INLINE": str(self.config.max_inline_object_size),
+            "RAY_TPU_POOL_BYTES": str(self.config.shm_pool_bytes),
+            "RAY_TPU_OBJECT_POOL_SIZE": str(self.config.object_pool_size),
+            "RAY_TPU_OBJECT_STRIPE_THRESHOLD":
+                str(self.config.object_stripe_threshold),
+            "RAY_TPU_ARG_PREFETCH_DEPTH":
+                str(self.config.arg_prefetch_depth),
+            "RAY_TPU_STREAMING_EXECUTOR":
+                "1" if self.config.streaming_executor else "0",
+            "RAY_TPU_DATA_MEMORY_BUDGET":
+                str(self.config.data_memory_budget),
+            "RAY_TPU_DATA_MEMORY_BUDGET_FRACTION":
+                str(self.config.data_memory_budget_fraction),
+            "RAY_TPU_DATA_MAX_INFLIGHT_TASKS":
+                str(self.config.data_max_inflight_tasks),
+        }
+
     def _spawn_worker(self, node: NodeState, env_key: str,
                       rec: Optional[TaskRecord], tpu_chips) -> WorkerHandle:
         import subprocess
@@ -1918,27 +1943,19 @@ class Runtime:
         env["PYTHONPATH"] = os.pathsep.join(
             [pkg_root] + extra + ([env["PYTHONPATH"]]
                                   if env.get("PYTHONPATH") else []))
+        env.update(self._worker_config_env())
         env.update({
             "RAY_TPU_WORKER_ID": worker_id.hex(),
             "RAY_TPU_ADDRESS": self._listener.address,
             "RAY_TPU_AUTHKEY": self._authkey.hex(),
             "RAY_TPU_SESSION": self.session_id,
             "RAY_TPU_SHM_DIR_OVERRIDE": self.shm._dir,
-            "RAY_TPU_MAX_INLINE": str(self.config.max_inline_object_size),
             "RAY_TPU_NODE_ID": node.node_id.hex(),
             "RAY_TPU_JOB_ID": self.job_id.hex(),
-            "RAY_TPU_POOL_BYTES": str(self.config.shm_pool_bytes),
             # Per-process slice of the node store cap + the shared spill
             # dir (per-node spilling; local_object_manager.h:41).
             "RAY_TPU_STORE_BYTES": str(self.config.object_store_memory),
             "RAY_TPU_SPILL_DIR_OVERRIDE": self.spill_dir,
-            # Data-plane knobs (pooled/striped cross-node pulls) follow
-            # _system_config overrides into workers via the env namespace.
-            "RAY_TPU_OBJECT_POOL_SIZE": str(self.config.object_pool_size),
-            "RAY_TPU_OBJECT_STRIPE_THRESHOLD":
-                str(self.config.object_stripe_threshold),
-            "RAY_TPU_ARG_PREFETCH_DEPTH":
-                str(self.config.arg_prefetch_depth),
         })
         env["RAY_TPU_STORE_ID"] = self.store_id
         # Worker output goes to a per-worker file (reference: workers log
@@ -1981,20 +1998,14 @@ class Runtime:
                 f"1,1,{len(tpu_chips)}"
         else:
             overrides["JAX_PLATFORMS"] = "cpu"
+        overrides.update(self._worker_config_env())
         overrides.update({
             "RAY_TPU_WORKER_ID": worker_id.hex(),
             "RAY_TPU_ADDRESS": self.tcp_address,
             "RAY_TPU_AUTHKEY": self._authkey.hex(),
             "RAY_TPU_SESSION": self.session_id,
-            "RAY_TPU_MAX_INLINE": str(self.config.max_inline_object_size),
             "RAY_TPU_NODE_ID": node.node_id.hex(),
             "RAY_TPU_JOB_ID": self.job_id.hex(),
-            "RAY_TPU_POOL_BYTES": str(self.config.shm_pool_bytes),
-            "RAY_TPU_OBJECT_POOL_SIZE": str(self.config.object_pool_size),
-            "RAY_TPU_OBJECT_STRIPE_THRESHOLD":
-                str(self.config.object_stripe_threshold),
-            "RAY_TPU_ARG_PREFETCH_DEPTH":
-                str(self.config.arg_prefetch_depth),
         })
         w = WorkerHandle(worker_id, None, None, node, env_key, tpu_chips)
         node.all_workers[id(w)] = w

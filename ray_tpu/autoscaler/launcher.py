@@ -230,13 +230,42 @@ class GCPTpuProvider(NodeProvider):
             f"queued resource {name} not READY after {timeout_s:.0f}s "
             f"(still tracked; `ray_tpu down` releases it)")
 
+    AUTHKEY_REMOTE_PATH = "~/.ray_tpu_authkey"
+
+    def _push_authkey(self, name: str):
+        """Deliver the cluster authkey as a 0600 file over scp.  It must
+        NEVER ride the remote command line: ``--command="RAY_TPU_CLIENT_
+        AUTHKEY=<hex> ..."`` lands the key in the remote shell's argv —
+        visible to every local user via ``ps`` and in shell/audit logs
+        on the TPU VM."""
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(prefix="rtpu-authkey-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(self._authkey_hex + "\n")
+            subprocess.run(
+                ["gcloud", "compute", "tpus", "tpu-vm", "scp", tmp,
+                 f"{name}:{self.AUTHKEY_REMOTE_PATH}",
+                 f"--project={self._conf['project']}",
+                 f"--zone={self._conf['zone']}", "--worker=all"],
+                capture_output=True, text=True, timeout=900.0, check=True)
+        finally:
+            os.unlink(tmp)
+
     def _bootstrap(self, name: str, node_type: str):
         """Run setup commands + start the node agent on every slice host
         (``--worker=all`` — a multi-host slice joins with one agent per
-        host, each owning its local chips)."""
+        host, each owning its local chips).  The authkey arrives as a
+        0600 file (scp, above); the agent command only references the
+        file, so the literal ``$(cat ...)`` — not the key — is what
+        appears in process listings."""
+        self._push_authkey(name)
         r = self.node_types[node_type]["resources"]
+        key_file = self.AUTHKEY_REMOTE_PATH
         agent_cmd = (
-            f"RAY_TPU_CLIENT_AUTHKEY={self._authkey_hex} "
+            f"chmod 600 {key_file} && "
+            f"RAY_TPU_CLIENT_AUTHKEY=$(cat {key_file}) "
             f"python3 -m ray_tpu.scripts agent "
             f"--address {self._head_address} "
             f"--num-cpus {r.get('CPU', 1)} "
@@ -244,6 +273,10 @@ class GCPTpuProvider(NodeProvider):
             f"</dev/null >/tmp/ray_tpu_agent.log 2>&1 &")
         script = " && ".join(self._setup + [agent_cmd]) \
             if self._setup else agent_cmd
+        if self._authkey_hex in script:  # belt + suspenders: the guard
+            # must survive `python -O` (assert would be compiled out)
+            raise RuntimeError(
+                "cluster authkey leaked into the remote command line")
         subprocess.run(
             ["gcloud", "compute", "tpus", "tpu-vm", "ssh", name,
              f"--project={self._conf['project']}",

@@ -6,8 +6,11 @@ executors, datasource plugins, split() feeding Train shards.
 
 Condensation here: blocks are object-store refs holding lists-of-rows,
 dict-of-numpy "tensor blocks", or pyarrow Tables; transforms build a lazy
-fused-op plan executed by a bounded-in-flight streaming executor
-(``streaming_executor.py:35`` analog); split/repartition plan row ranges
+plan compiled into a DAG of fused physical operators and executed by the
+backpressured streaming engine (``data/streaming_executor.py`` —
+per-operator queues, global in-flight byte budget; the
+``streaming_executor.py:35`` analog, legacy windowed path behind
+``config.streaming_executor=off``); split/repartition plan row ranges
 and cut blocks with tasks (no driver materialization); IO goes through
 pyarrow (parquet/csv/json).  The Train integration contract is the same:
 ``ds.split(k)`` -> per-worker shards, ``shard.iter_batches()`` inside the

@@ -1,5 +1,5 @@
 """Dataset: lazy fused-op plan over object-store blocks, executed by a
-bounded-in-flight streaming executor.
+backpressured streaming operator-graph engine.
 
 Reference: ``python/ray/data/dataset.py:166`` (4.5k LoC Dataset),
 ``_internal/plan.py`` (ExecutionPlan), and the streaming executor
@@ -7,12 +7,17 @@ Reference: ``python/ray/data/dataset.py:166`` (4.5k LoC Dataset),
 from the reference's model, re-designed small:
 
 - **Lazy plan + operator fusion**: transforms append ops to a plan; at
-  execution one task per block applies the whole fused chain (the
-  reference fuses compatible map-like operators the same way).
-- **Streaming with backpressure**: consumers pull block refs through a
-  sliding window of at most ``max_in_flight`` concurrent block tasks, so
-  a dataset larger than driver RAM streams through without materializing
-  (``streaming_executor.py`` bounded resource admission).
+  execution the plan compiles to physical operators, consecutive
+  compatible map-like ops fusing into one task per block (the reference
+  fuses the same way; per-op ``num_cpus`` is a fusion boundary).
+- **Streaming with backpressure**: consumers pull block refs through the
+  operator-graph executor (``streaming_executor.py``): per-operator
+  input/output queues, admission under a global in-flight BYTE budget
+  (``config.data_memory_budget``), and slowest-consumer-first dispatch,
+  so a dataset larger than driver RAM streams through with peak store
+  bytes bounded.  ``config.streaming_executor=off`` falls back to the
+  legacy windowed chain-submission path (at most ``max_in_flight``
+  whole-chain block tasks; memory bounded in block count only).
 - **No driver materialization for layout ops**: ``split``/``repartition``
   plan row ranges from per-block counts and cut blocks with tasks —
   rows move store-to-store, never through the driver (the round-2
@@ -311,45 +316,100 @@ class Dataset:
         return Dataset._from_segments(
             [(blocks, ops + (op,)) for blocks, ops in self._segments])
 
-    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
-        return self._with_op(("map", fn))
+    @staticmethod
+    def _task_op(base: tuple, num_cpus) -> tuple:
+        """Append the per-op resource opts only when requested: plan
+        tuples from pre-existing call sites stay byte-identical, and the
+        opts dict is both the streaming engine's fusion boundary and its
+        task resource request.  The legacy windowed path fuses the whole
+        chain regardless and runs it at the default 1 CPU."""
+        if num_cpus is None:
+            return base
+        return base + ({"num_cpus": num_cpus},)
 
-    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
-        return self._with_op(("filter", fn))
+    def map(self, fn: Callable[[Any], Any], *,
+            num_cpus: Optional[float] = None) -> "Dataset":
+        return self._with_op(self._task_op(("map", fn), num_cpus))
 
-    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
-        return self._with_op(("flat_map", fn))
+    def filter(self, fn: Callable[[Any], bool], *,
+               num_cpus: Optional[float] = None) -> "Dataset":
+        return self._with_op(self._task_op(("filter", fn), num_cpus))
+
+    def flat_map(self, fn: Callable[[Any], List[Any]], *,
+                 num_cpus: Optional[float] = None) -> "Dataset":
+        return self._with_op(self._task_op(("flat_map", fn), num_cpus))
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
                     compute: Optional[str] = None,
-                    concurrency: int = 2) -> "Dataset":
+                    concurrency: int = 2,
+                    num_cpus: Optional[float] = None) -> "Dataset":
         """``compute="actors"`` runs ``fn`` on a pool of long-lived
         actors — a CLASS fn is instantiated once per actor, carrying
         state (model weights etc.) across blocks (reference:
         execution/operators/actor_pool_map_operator.py +
-        ActorPoolStrategy)."""
+        ActorPoolStrategy).  ``num_cpus`` sets the per-task CPU request
+        of task-compute ops (heterogeneous per-operator resources; a
+        differing request is a fusion boundary in the streaming
+        engine)."""
         if compute == "actors":
             from ray_tpu.data.execution import ACTOR_OP
 
+            if num_cpus is not None:
+                raise ValueError(
+                    "num_cpus applies to task compute; actor pools "
+                    "reserve 1 CPU per actor")
             return self._with_op((ACTOR_OP, fn, batch_format,
                                   max(1, int(concurrency))))
         if compute not in (None, "tasks"):
             raise ValueError(f"compute must be 'tasks' or 'actors', "
                              f"got {compute!r}")
-        return self._with_op(("map_batches", fn, batch_format))
+        return self._with_op(self._task_op(
+            ("map_batches", fn, batch_format), num_cpus))
 
     # ------------------------------------------------------------- execution
     def _stream_refs(self, window: Optional[int] = None) -> Iterator[Any]:
-        """Yield executed block refs in order, keeping at most ``window``
-        blocks in flight end-to-end — the streaming executor
-        (streaming_executor.py:35 bounded admission).  The fused chain
-        splits into STAGES at actor-compute ops (execution.py); a
-        block's whole stage chain is submitted at once and pipelines on
-        dependency resolution.  Per-op stats accumulate on ``_stats``.
+        """Yield executed block refs in order.  Default engine: the
+        backpressured operator-graph executor (streaming_executor.py) —
+        fused physical operators, per-operator queues, admission under
+        the ``data_memory_budget`` byte budget.  ``window`` is the
+        caller's concurrency hint (``materialize`` opens it to the
+        block count, ``iter_batches`` to ``prefetch_blocks``): the
+        streaming engine lets an explicit window RAISE its in-flight
+        task cap above the auto default (the byte budget still bounds
+        memory); the legacy path (config.streaming_executor=off) keeps
+        it as its chain window.  Per-op stats accumulate on ``_stats``.
         A fully-drained stream memoizes its refs."""
         if self._cached_refs is not None:
             yield from self._cached_refs
             return
+        from ray_tpu._private import api_internal
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu.data import execution as _ex
+
+        rt = api_internal.require_runtime()
+        cfg = getattr(rt, "config", None) or GLOBAL_CONFIG
+        if getattr(cfg, "streaming_executor", True):
+            from ray_tpu.data import streaming_executor as _se
+
+            stats = self._stats = _ex.DatasetStats()
+            stats.note_start()
+            produced: List[Any] = []
+            for ref in _se.execute(self._segments, rt, cfg, stats,
+                                   window=window):
+                produced.append(ref)
+                yield ref
+            self._cached_refs = produced
+            stats.note_end()
+            return
+        yield from self._stream_refs_windowed(window)
+
+    def _stream_refs_windowed(self,
+                              window: Optional[int] = None) -> Iterator[Any]:
+        """The pre-streaming-engine path, kept for A/B: at most
+        ``window`` whole block CHAINS in flight (count-bounded, not
+        byte-bounded); a block's full stage chain is submitted at once
+        and pipelines on dependency resolution (stages split at
+        actor-compute ops, execution.py)."""
         from ray_tpu.data import execution as _ex
 
         window = window or DEFAULT_STREAMING_WINDOW
@@ -417,8 +477,13 @@ class Dataset:
     def materialize(self) -> "Dataset":
         """Execute the plan fully; the result holds plain block refs
         (reference: Dataset.materialize).  Eager execution wants
-        THROUGHPUT, not bounded memory: the window opens to the full
-        block count so every execution slot in the cluster is used."""
+        THROUGHPUT: the window argument opens to the full block count so
+        the task-count cap never binds (every execution slot is usable).
+        Under the streaming engine the byte budget
+        (``config.data_memory_budget``) still gates admission — the
+        result set is retained anyway, but intermediates stay bounded;
+        the legacy windowed path (``streaming_executor=off``) runs
+        unbounded as before."""
         if self._cached_refs is not None:
             return Dataset(self._cached_refs)
         if all(not ops for _, ops in self._segments):

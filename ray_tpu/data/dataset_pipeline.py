@@ -4,8 +4,9 @@ Reference: ``python/ray/data/dataset_pipeline.py`` — a DatasetPipeline is
 a sequence of Datasets (windows) executed one window at a time, so a
 training loop streams through data larger than the object store instead
 of materializing it all.  Transforms apply lazily per window; iteration
-drives exactly one window's tasks at a time (each window's own streaming
-executor bounds in-flight blocks within it).
+drives exactly one window's tasks at a time, and within a window the
+operator-graph streaming executor bounds in-flight BYTES (legacy path:
+in-flight block count) — see streaming_executor.py.
 """
 
 from __future__ import annotations
@@ -24,22 +25,24 @@ class DatasetPipeline:
                      ) -> "DatasetPipeline":
         return DatasetPipeline([f(w) for w in self._windows])
 
-    def map(self, fn) -> "DatasetPipeline":
-        return self._map_windows(lambda w: w.map(fn))
+    def map(self, fn, *, num_cpus=None) -> "DatasetPipeline":
+        return self._map_windows(lambda w: w.map(fn, num_cpus=num_cpus))
 
-    def filter(self, fn) -> "DatasetPipeline":
-        return self._map_windows(lambda w: w.filter(fn))
+    def filter(self, fn, *, num_cpus=None) -> "DatasetPipeline":
+        return self._map_windows(lambda w: w.filter(fn, num_cpus=num_cpus))
 
-    def flat_map(self, fn) -> "DatasetPipeline":
-        return self._map_windows(lambda w: w.flat_map(fn))
+    def flat_map(self, fn, *, num_cpus=None) -> "DatasetPipeline":
+        return self._map_windows(
+            lambda w: w.flat_map(fn, num_cpus=num_cpus))
 
     def map_batches(self, fn, *, batch_format: str = "numpy",
-                    compute=None, concurrency: int = 2
-                    ) -> "DatasetPipeline":
+                    compute=None, concurrency: int = 2,
+                    num_cpus=None) -> "DatasetPipeline":
         return self._map_windows(
             lambda w: w.map_batches(fn, batch_format=batch_format,
                                     compute=compute,
-                                    concurrency=concurrency))
+                                    concurrency=concurrency,
+                                    num_cpus=num_cpus))
 
     def stats(self) -> str:
         """Concatenated per-window execution stats (reference:

@@ -191,13 +191,19 @@ class ActorPoolMapOperator:
 
 class DatasetStats:
     """Aggregated per-operator accounting behind ``ds.stats()``
-    (reference: _internal/stats.py DatasetStatsSummary)."""
+    (reference: _internal/stats.py DatasetStatsSummary).  When the
+    streaming engine ran, its ``StreamingStats`` snapshot attaches as
+    ``self.streaming`` and ``__str__`` gains per-operator
+    queued/in-flight/peak-bytes rows (surfaced like
+    ``Runtime.transfer_stats()``); on the legacy windowed path it stays
+    ``None`` and every streaming counter reads zero."""
 
     def __init__(self):
         self._ops: Dict[str, Dict[str, float]] = {}
         self._stats_refs: List[Any] = []
         self._wall_start: Optional[float] = None
         self._wall_end: Optional[float] = None
+        self.streaming = None  # StreamingStats of the last streaming run
 
     def note_start(self):
         if self._wall_start is None:
@@ -209,19 +215,34 @@ class DatasetStats:
     def add_ref(self, stats_ref):
         self._stats_refs.append(stats_ref)
 
+    def add_stats(self, per_block: List[dict]):
+        """Fold one block's per-op stats list directly (the streaming
+        executor materializes stats at task completion, so there is no
+        ref to drain later)."""
+        for s in per_block or ():
+            agg = self._ops.setdefault(
+                s["op"], {"blocks": 0, "wall_s": 0.0, "rows_out": 0,
+                          "bytes_out": 0})
+            agg["blocks"] += 1
+            agg["wall_s"] += s["wall_s"]
+            agg["rows_out"] += s["rows_out"]
+            agg["bytes_out"] += s["bytes_out"]
+
+    def streaming_summary(self) -> Dict[str, Any]:
+        """Engine counters of the last run; all-zero when the legacy
+        windowed path executed (config.streaming_executor=off)."""
+        from ray_tpu.data import streaming_executor as _se
+
+        if self.streaming is None:
+            return _se.empty_summary()
+        return self.streaming.summary()
+
     def _drain(self):
         if not self._stats_refs:
             return
         refs, self._stats_refs = self._stats_refs, []
         for per_block in ray.get(refs):
-            for s in per_block:
-                agg = self._ops.setdefault(
-                    s["op"], {"blocks": 0, "wall_s": 0.0, "rows_out": 0,
-                              "bytes_out": 0})
-                agg["blocks"] += 1
-                agg["wall_s"] += s["wall_s"]
-                agg["rows_out"] += s["rows_out"]
-                agg["bytes_out"] += s["bytes_out"]
+            self.add_stats(per_block)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         self._drain()
@@ -240,4 +261,21 @@ class DatasetStats:
                 f"  {op}: {agg['blocks']} blocks, "
                 f"{agg['wall_s'] * 1e3:.1f}ms task time, "
                 f"{int(agg['rows_out'])} rows out, {mb:.2f}MB out")
+        if self.streaming is not None:
+            s = self.streaming.summary()
+            lines.append(
+                f"Streaming executor: peak in-flight "
+                f"{s['peak_inflight_bytes'] / 1e6:.2f}MB of "
+                f"{s['budget_bytes'] / 1e6:.2f}MB budget, "
+                f"{s['admitted_tasks']} tasks "
+                f"({s['cancelled_tasks']} cancelled, "
+                f"{s['backpressure_stalls']} backpressure stalls)")
+            for name, row in s["ops"].items():
+                lines.append(
+                    f"  [op {name}] queued {row['queued_blocks']} blocks"
+                    f"/{row['queued_bytes'] / 1e6:.2f}MB "
+                    f"(peak {row['peak_queued_bytes'] / 1e6:.2f}MB), "
+                    f"in-flight peak {row['peak_inflight']}, "
+                    f"out {row['out_blocks']} blocks"
+                    f"/{row['out_bytes'] / 1e6:.2f}MB")
         return "\n".join(lines) or "Dataset: no execution recorded"

@@ -19,6 +19,7 @@ import ray_tpu as ray
 from ray_tpu.data.dataset import (
     Dataset, _block_rows, _hash_partition, _keyfn_of,
 )
+from ray_tpu.remote_function import _bulk_submit
 
 
 class AggregateFn:
@@ -148,10 +149,14 @@ class GroupedDataset:
         self._key = key
 
     def _shuffled_parts(self):
+        """Hash-partition every (engine-executed) block; both the map
+        and reduce fan-outs go through the bulk submission path — one
+        dispatch pass per side instead of one per block/reducer."""
         blocks = self._ds._executed_refs()
         n = max(1, len(blocks))
-        parts = [_hash_partition.options(num_returns=n).remote(
-            b, self._key, n) for b in blocks]
+        mapper = _hash_partition.options(num_returns=n)
+        parts = _bulk_submit([(mapper, (b, self._key, n), None)
+                              for b in blocks])
         if n == 1:
             parts = [[p] for p in parts]
         return n, parts
@@ -160,20 +165,22 @@ class GroupedDataset:
         if not aggs:
             raise ValueError("aggregate() needs at least one AggregateFn")
         n, parts = self._shuffled_parts()
-        out = [_agg_reduce.remote(self._key, list(aggs),
-                                  *[parts[i][j]
-                                    for i in builtins.range(len(parts))])
-               for j in builtins.range(n)]
+        out = _bulk_submit([
+            (_agg_reduce,
+             (self._key, list(aggs),
+              *[parts[i][j] for i in builtins.range(len(parts))]), None)
+            for j in builtins.range(n)])
         return Dataset(out)
 
     def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
         """reference: grouped_dataset.py map_groups — fn sees the full
         row list of one group."""
         n, parts = self._shuffled_parts()
-        out = [_map_groups_task.remote(self._key, fn,
-                                       *[parts[i][j]
-                                         for i in builtins.range(len(parts))])
-               for j in builtins.range(n)]
+        out = _bulk_submit([
+            (_map_groups_task,
+             (self._key, fn,
+              *[parts[i][j] for i in builtins.range(len(parts))]), None)
+            for j in builtins.range(n)])
         return Dataset(out)
 
     def count(self) -> Dataset:

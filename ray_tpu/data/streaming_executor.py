@@ -1,0 +1,545 @@
+"""Backpressured streaming operator-graph execution engine.
+
+Reference: ``python/ray/data/_internal/execution/streaming_executor.py:35``
+— the physical plan is a DAG of operators, each owning input/output
+queues and an in-flight task set, driven by a dispatch loop that admits
+work under a global resource budget — plus
+``execution/operators/map_operator.py`` (fusion of compatible map-like
+transforms into one physical operator) and the bounded-memory
+pipelined-operator argument of the Exoshuffle / Ownership (NSDI'21)
+papers (PAPERS.md).
+
+The legacy windowed path (``Dataset._stream_refs_windowed``) keeps a
+window of ``max_in_flight`` whole block CHAINS in flight: memory is
+bounded only in block *count*, a slow operator's backlog is invisible
+(everything upstream keeps running until the window fills), and
+heterogeneous per-operator resources cannot be expressed.  This engine
+replaces that with:
+
+- **Compilation + fusion** — the logical ``ops`` tuple compiles into a
+  chain of physical operators.  Consecutive task-compute ops
+  (map / filter / flat_map / map_batches) whose resource requests match
+  fuse into a single ``_MapOperator`` — one task per block per fused
+  chain instead of one per op.  ``compute="actors"`` ops become
+  ``_ActorOperator`` stages over a lazily-created actor pool and never
+  fuse across the boundary.
+- **Byte-budgeted admission** — every completed block's size rides the
+  per-op stats the task already returns (cross-checked against the
+  ``("shm", name, size, store_id)`` descriptor when the driver's object
+  table is reachable); the dispatch loop admits a new task only while
+  *queued intermediate bytes + estimated in-flight output bytes* stay
+  under ``config.data_memory_budget`` (default: a fraction of the
+  object-store capacity; env ``RAY_TPU_DATA_MEMORY_BUDGET``).
+- **Backpressure by construction** — on every completion the loop picks
+  the runnable operator with the *smallest queued output bytes* (ties
+  to the deeper operator), so a fast upstream operator stalls when its
+  consumer lags instead of flooding the store, while independent
+  operators (different chains of a ``union``, different pipeline
+  stages) pipeline freely.
+- **Failure isolation** — a task error surfaces to the consumer
+  immediately and every outstanding task is cancelled (the legacy path
+  left the rest of the window running).
+
+The executor is *driven entirely by the consuming generator's thread*:
+operator queues, in-flight maps and byte accounting are single-threaded
+state and need no locks.
+
+LOCK ORDER: ``StreamingStats._lock`` is an independent LEAF — it guards
+only the counter snapshot read by ``Dataset.stats()`` (potentially from
+another thread, mid-stream); no other lock is ever acquired while
+holding it and it is never held across task submission, ``ray.wait`` or
+``ray.get``.  Pinned in tests/test_lockcheck.py alongside the
+object_transfer / shm_store leaves.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu as ray
+from ray_tpu.data import execution as _ex
+
+# Per-op resource opts dict appended to task-compute op tuples by
+# Dataset.map/.filter/.flat_map/.map_batches(num_cpus=...).  Absent on
+# ops built by older call sites — `_op_opts` treats both the same.
+def _op_opts(op) -> dict:
+    return op[-1] if isinstance(op[-1], dict) else {}
+
+
+def _strip_opts(op) -> tuple:
+    return op[:-1] if isinstance(op[-1], dict) else op
+
+
+class StreamingStats:
+    """Engine-level counters behind ``Dataset.stats()`` (surfaced like
+    ``Runtime.transfer_stats()``: a flat snapshot dict plus per-operator
+    rows).  All mutation happens on the executor's driving thread; the
+    leaf ``_lock`` only makes snapshots consistent for concurrent
+    readers."""
+
+    def __init__(self, budget_bytes: int, inflight_cap: int):
+        self._lock = threading.Lock()  # LEAF — see module docstring
+        self.budget_bytes = budget_bytes
+        self.inflight_cap = inflight_cap
+        self.peak_inflight_bytes = 0
+        self.admitted_tasks = 0
+        self.completed_tasks = 0
+        self.cancelled_tasks = 0
+        self.backpressure_stalls = 0
+        self.ops: Dict[str, Dict[str, int]] = {}
+
+    def op_row(self, name: str) -> Dict[str, int]:
+        with self._lock:
+            return self.ops.setdefault(name, {
+                "queued_blocks": 0, "queued_bytes": 0,
+                "peak_queued_bytes": 0, "inflight": 0,
+                "peak_inflight": 0, "out_blocks": 0, "out_bytes": 0,
+            })
+
+    def note_live_bytes(self, live: int):
+        with self._lock:
+            if live > self.peak_inflight_bytes:
+                self.peak_inflight_bytes = live
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "inflight_cap": self.inflight_cap,
+                "peak_inflight_bytes": self.peak_inflight_bytes,
+                "admitted_tasks": self.admitted_tasks,
+                "completed_tasks": self.completed_tasks,
+                "cancelled_tasks": self.cancelled_tasks,
+                "backpressure_stalls": self.backpressure_stalls,
+                "ops": {k: dict(v) for k, v in self.ops.items()},
+            }
+
+
+def empty_summary() -> Dict[str, Any]:
+    """The all-zero snapshot the legacy path reports (acceptance: with
+    ``streaming_executor=off`` every new counter is zero).  Derived from
+    a fresh ``StreamingStats`` so the two paths can never diverge in
+    shape."""
+    return StreamingStats(0, 0).summary()
+
+
+# ------------------------------------------------------------- operators --
+class _MapOperator:
+    """A fused chain of task-compute ops: one ``apply_stage_with_stats``
+    task per block, honoring the chain's (shared) resource request."""
+
+    kind = "tasks"
+
+    def __init__(self, ops: Tuple[tuple, ...], opts: dict):
+        self.ops = tuple(_strip_opts(op) for op in ops)
+        self.opts = dict(opts)
+        self.name = "+".join(op[0] for op in self.ops)
+        self._handle = (_ex.apply_stage_with_stats.options(**self.opts)
+                        if self.opts else _ex.apply_stage_with_stats)
+
+    def submit(self, block_ref):
+        bref, sref = self._handle.remote(self.ops, block_ref)
+        return bref, sref, None
+
+    def on_done(self, note):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+class _ActorOperator:
+    """An actor-pool stage (``compute="actors"``); the pool is created on
+    first admission so empty datasets never spawn actors."""
+
+    kind = "actors"
+
+    def __init__(self, op: tuple):
+        self._op = op
+        self.name = "map_batches(actors)"
+        self._pool: Optional[_ex.ActorPoolMapOperator] = None
+
+    def submit(self, block_ref):
+        if self._pool is None:
+            self._pool = _ex.ActorPoolMapOperator(
+                self._op[1], self._op[2], self._op[3])
+        bref, sref, idx = self._pool.submit((), block_ref)
+        return bref, sref, idx
+
+    def on_done(self, note):
+        if self._pool is not None and note is not None:
+            self._pool.done(note)
+
+    def shutdown(self):
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+
+def compile_chain(ops: tuple, pools: Dict[int, _ActorOperator]) -> List[Any]:
+    """Logical op tuple -> physical operator chain.  Fusion rule:
+    consecutive task ops with the same NORMALIZED resource request fuse
+    (the scheduler's `_normalize_resources`, so an explicit ``num_cpus=1``
+    and the unannotated 1-CPU default are one chain); actor ops are
+    their own stage (shared across segments carrying the identical op
+    object, e.g. after ``union`` of one transformed dataset).  Actor
+    boundaries come from the legacy path's ``split_stages`` — ONE
+    boundary-splitting implementation for both engines — and only the
+    resource-key subdivision of task stages is engine-specific."""
+    from ray_tpu.remote_function import _normalize_resources
+
+    operators: List[Any] = []
+    for kind, stage in _ex.split_stages(ops):
+        if kind == "actors":
+            shared = pools.get(id(stage))
+            if shared is None:
+                shared = pools[id(stage)] = _ActorOperator(stage)
+            operators.append(shared)
+            continue
+        cur: List[tuple] = []
+        cur_opts: dict = {}
+        cur_key: Optional[tuple] = None
+        for op in stage:
+            opts = _op_opts(op)
+            key = tuple(sorted(_normalize_resources(opts).items()))
+            if cur and key != cur_key:
+                operators.append(_MapOperator(tuple(cur), cur_opts))
+                cur, cur_opts = [], {}
+            cur.append(op)
+            cur_key = key
+            # The sub-chain submits under the first annotated op's opts
+            # (all members normalize identically, so any one is the
+            # request).
+            cur_opts = cur_opts or dict(opts)
+        if cur:
+            operators.append(_MapOperator(tuple(cur), cur_opts))
+    return operators
+
+
+class _OpState:
+    """Runtime state of one physical operator instance within one chain:
+    input queue, in-flight task set, queued-output accounting."""
+
+    __slots__ = ("op", "row", "depth", "prev", "next", "inq", "inq_bytes",
+                 "inflight", "queued_out_bytes", "out_sum", "out_n")
+
+    def __init__(self, op, row, depth):
+        self.op = op
+        self.row = row          # StreamingStats row dict
+        self.depth = depth
+        self.prev: Optional[_OpState] = None
+        self.next: Optional[_OpState] = None
+        # (seq, ref, nbytes, counted) — `counted` marks executor-produced
+        # blocks, whose bytes are charged to the budget until their
+        # consuming task completes; source blocks (which exist whether or
+        # not the executor runs) are sized for estimates/stats only.
+        self.inq: deque = deque()
+        self.inq_bytes = 0
+        # head block ref -> (seq, stats_ref, input_ref, in_bytes,
+        #                    in_counted, est_out, pool_note)
+        self.inflight: Dict[Any, tuple] = {}
+        self.queued_out_bytes = 0
+        self.out_sum = 0        # completed output bytes (for estimates)
+        self.out_n = 0
+
+    def est_out_bytes(self) -> int:
+        """Expected output size of the next admitted task: the running
+        mean of completed outputs, else the input block's size (all we
+        know before the first completion)."""
+        if self.out_n:
+            return self.out_sum // self.out_n
+        return self.inq[0][2] if self.inq else 0
+
+
+# --------------------------------------------------------------- budgets --
+def resolve_budget(rt, cfg) -> int:
+    if cfg.data_memory_budget:
+        return int(cfg.data_memory_budget)
+    shm = getattr(rt, "shm", None)
+    cap = int(getattr(shm, "_capacity", 0) or 0) if shm is not None else 0
+    if not cap and shm is not None:
+        try:
+            st = os.statvfs(shm._dir)
+            cap = st.f_frsize * st.f_blocks
+        except (OSError, AttributeError):
+            cap = 0
+    if not cap:
+        cap = 1 << 32  # no readable store bound: 4 GB stand-in
+    return max(1, int(cap * cfg.data_memory_budget_fraction))
+
+
+def resolve_inflight_cap(rt, cfg) -> int:
+    if cfg.data_max_inflight_tasks:
+        return int(cfg.data_max_inflight_tasks)
+    try:
+        total = rt.cluster_resources().get("CPU", 0)
+    except Exception:
+        total = 0
+    return max(1, int(total)) if total else 8
+
+
+def _descr_nbytes_many(rt, refs) -> List[int]:
+    """Block sizes from the driver's object table (the size every
+    shm/spilled descriptor carries) for all ``refs`` under ONE
+    acquisition of the driver-wide runtime lock — stream setup sizes
+    every source block and every completion round settles in one pass,
+    so a 10k-block dataset never takes the contended lock 10k times.
+    All-zero when unreadable (worker/client runtimes keep no table —
+    callers fall back to stats-reported bytes)."""
+    descrs: List[Any] = [None] * len(refs)
+    try:
+        with rt.lock:
+            for i, ref in enumerate(refs):
+                st = rt.objects.get(ref.id())
+                descrs[i] = st.descr if st is not None else None
+    except Exception:
+        return [0] * len(refs)
+    sizes = []
+    for d in descrs:
+        if d is not None and d[0] in ("shm", "spilled"):
+            sizes.append(int(d[2]))
+        elif d is not None and d[0] == "inline":
+            sizes.append(len(d[1]))
+        else:
+            sizes.append(0)
+    return sizes
+
+
+# -------------------------------------------------------------- executor --
+def execute(segments, rt, cfg, dstats, window=None):
+    """Yield executed block refs of ``segments`` in order — the streaming
+    replacement for the windowed chain submission.  ``dstats`` is the
+    Dataset's ``DatasetStats``; per-op rows accumulate there and the
+    engine snapshot attaches as ``dstats.streaming``.  ``window`` is the
+    caller's legacy-shaped concurrency hint (``materialize`` opens it to
+    the block count, ``iter_batches`` to ``prefetch_blocks``): it can
+    RAISE the in-flight task cap above the auto default, while the byte
+    budget still bounds memory."""
+    budget = resolve_budget(rt, cfg)
+    cap = resolve_inflight_cap(rt, cfg)
+    if not cfg.data_max_inflight_tasks:
+        # The window hint only widens the AUTO cap; an explicitly
+        # configured task cap is a hard bound, like an explicit budget.
+        cap = max(cap, int(window or 0))
+    # An explicitly configured budget is a HARD bound: operators whose
+    # output size is still unknown run one task at a time (an output-size
+    # probe) so a first wave of admissions cannot collectively overshoot.
+    # The auto budget (a store-capacity fraction) stays optimistic —
+    # input-size estimates, full first-wave fan-out.
+    strict = bool(cfg.data_memory_budget)
+    stats = StreamingStats(budget, cap)
+    dstats.streaming = stats
+
+    # ---- compile ----
+    pools: Dict[int, _ActorOperator] = {}
+    states: List[_OpState] = []
+    final_buf: Dict[int, tuple] = {}   # seq -> (ref, nbytes, producer)
+    chain_heads: List[Optional[_OpState]] = []
+    seen_names: Dict[str, int] = {}
+    for blocks, ops in segments:
+        operators = compile_chain(ops, pools)
+        chain: List[_OpState] = []
+        for depth, op in enumerate(operators):
+            n = seen_names.get(op.name, 0)
+            seen_names[op.name] = n + 1
+            row_name = op.name if n == 0 else f"{op.name}#{n}"
+            st = _OpState(op, stats.op_row(row_name), depth)
+            if chain:
+                chain[-1].next = st
+                st.prev = chain[-1]
+            chain.append(st)
+        states.extend(chain)
+        chain_heads.append(chain[0] if chain else None)
+
+    source = [(head, b)
+              for (blocks, _ops), head in zip(segments, chain_heads)
+              for b in blocks]
+    sizes = _descr_nbytes_many(rt, [b for _, b in source])
+    for seq, ((head, b), nb) in enumerate(zip(source, sizes)):
+        if head is None:
+            final_buf[seq] = (b, 0, None)
+        else:
+            head.inq.append((seq, b, nb, False))
+            head.inq_bytes += nb
+    total_blocks = len(source)
+
+    live = {"bytes": 0, "inflight": 0}
+    # Largest single completed output so far: ordinary admissions keep
+    # this much headroom under the budget, so the forced-progress
+    # admission (which may not respect the budget) still lands within
+    # it — the engine's bound is then `peak <= budget` whenever the
+    # budget covers one downstream working set (in + out + one queued
+    # block); blocks that keep GROWING along the pipeline can still
+    # overshoot by at most one block.
+    headroom = {"v": 0}
+    owner: Dict[Any, _OpState] = {}   # in-flight head ref -> opstate
+    next_yield = 0
+
+    def _admit():
+        """Admit tasks until budget/cap/backpressure stops them.
+        Operator choice is backpressure by construction: the runnable
+        operator with the SMALLEST queued output bytes goes first (ties
+        to the deeper one), so producers whose consumers lag wait.  When
+        nothing at all is in flight the first admission ignores the
+        budget — a single block larger than the budget must still make
+        progress."""
+        while True:
+            if live["inflight"] >= cap:
+                return
+            cands = [s for s in states if s.inq]
+            if not cands:
+                return
+            if live["inflight"] == 0:
+                # Forced progress: nothing runs, so the budget cannot be
+                # respected without deadlock.  Admit the operator whose
+                # queue holds the OLDEST block — the one blocking the
+                # next ordered yield — so the overshoot is the minimum
+                # that restores progress (at most one task's footprint).
+                s = min(cands, key=lambda s: s.inq[0][0])
+                est = s.est_out_bytes()
+            else:
+                s = None
+                for cand in sorted(cands, key=lambda s:
+                                   (s.queued_out_bytes, -s.depth)):
+                    if strict and cand.out_n == 0 and cand.inflight:
+                        continue  # output-size probe still outstanding
+                    s = cand
+                    break
+                if s is None:
+                    return
+                est = s.est_out_bytes()
+                if live["bytes"] + est > budget - headroom["v"]:
+                    with stats._lock:
+                        stats.backpressure_stalls += 1
+                    return
+            sq, in_ref, in_bytes, counted = s.inq.popleft()
+            s.inq_bytes -= in_bytes
+            if s.prev is not None:
+                s.prev.queued_out_bytes -= in_bytes
+            bref, sref, note = s.op.submit(in_ref)
+            s.inflight[bref] = (sq, sref, in_ref, in_bytes, counted,
+                                est, note)
+            owner[bref] = s
+            live["bytes"] += est
+            live["inflight"] += 1
+            with stats._lock:
+                stats.admitted_tasks += 1
+                s.row["inflight"] += 1
+                s.row["peak_inflight"] = max(s.row["peak_inflight"],
+                                             s.row["inflight"])
+                s.row["queued_blocks"] = len(s.inq)
+                s.row["queued_bytes"] = s.inq_bytes
+            stats.note_live_bytes(live["bytes"])
+
+    def _complete_batch(brefs):
+        """Settle one wait round's completions: ONE object-table pass
+        for exact sizes and ONE ``ray.get`` over the stats refs (which
+        raises the first task error — the engine then cancels), instead
+        of a driver-lock acquisition + blocking get per task."""
+        recs = []
+        for bref in brefs:
+            s = owner.pop(bref)
+            rec = s.inflight.pop(bref)
+            s.op.on_done(rec[-1])
+            recs.append((bref, s, rec))
+        sizes = _descr_nbytes_many(rt, brefs)
+        all_stats = ray.get([rec[1] for _, _, rec in recs])
+        for (bref, s, rec), nbytes, block_stats in zip(recs, sizes,
+                                                       all_stats):
+            _settle(bref, s, rec, nbytes, block_stats)
+
+    def _settle(bref, s, rec, nbytes, block_stats):
+        sq, sref, in_ref, in_bytes, counted, est, note = rec
+        # Exact store-descriptor size first — the UDF-side stats bytes
+        # are a heuristic (rows-of-dicts estimate at 64 B/row) and an
+        # explicit budget must not be enforced against a number that can
+        # undercount by orders of magnitude.  The stats figure covers
+        # inlined blocks and worker/client runtimes (no object table).
+        if not nbytes:
+            nbytes = int(block_stats[-1].get("bytes_out", 0)) \
+                if block_stats else 0
+        dstats.add_stats(block_stats)
+        s.out_sum += nbytes
+        s.out_n += 1
+        headroom["v"] = max(headroom["v"], nbytes)
+        # The consumed input ref is dropped here (the last executor
+        # reference): the intermediate block's store bytes free now.
+        del in_ref
+        live["bytes"] += nbytes - est - (in_bytes if counted else 0)
+        live["inflight"] -= 1
+        s.queued_out_bytes += nbytes
+        if s.next is not None:
+            s.next.inq.append((sq, bref, nbytes, True))
+            s.next.inq_bytes += nbytes
+            with stats._lock:
+                s.next.row["queued_blocks"] = len(s.next.inq)
+                s.next.row["queued_bytes"] = s.next.inq_bytes
+                s.next.row["peak_queued_bytes"] = max(
+                    s.next.row["peak_queued_bytes"], s.next.inq_bytes)
+        else:
+            final_buf[sq] = (bref, nbytes, s)
+        with stats._lock:
+            stats.completed_tasks += 1
+            s.row["inflight"] -= 1
+            s.row["out_blocks"] += 1
+            s.row["out_bytes"] += nbytes
+            s.row["peak_queued_bytes"] = max(s.row["peak_queued_bytes"],
+                                             s.row["queued_bytes"])
+        stats.note_live_bytes(live["bytes"])
+
+    def _cancel_outstanding():
+        for s in states:
+            for bref in list(s.inflight):
+                note = s.inflight.pop(bref)[-1]
+                s.op.on_done(note)
+                owner.pop(bref, None)
+                try:
+                    done, _ = ray.wait([bref], num_returns=1, timeout=0)
+                    finished = bool(done)
+                except Exception:
+                    finished = False
+                try:
+                    ray.cancel(bref)
+                except Exception:
+                    pass  # worker/client mode or already finished
+                if not finished:
+                    # Count only tasks that were genuinely cut short;
+                    # a task that completed while we were tearing down
+                    # was not cancelled, its result is just unread.
+                    with stats._lock:
+                        stats.cancelled_tasks += 1
+            s.inq.clear()
+        for pool in pools.values():
+            pool.shutdown()
+
+    try:
+        while next_yield < total_blocks:
+            while next_yield in final_buf:
+                ref, nbytes, producer = final_buf.pop(next_yield)
+                live["bytes"] -= nbytes
+                if producer is not None:
+                    producer.queued_out_bytes -= nbytes
+                next_yield += 1
+                yield ref
+            if next_yield >= total_blocks:
+                break
+            _admit()
+            heads = list(owner)
+            if not heads:
+                # The drain loop above already emptied every consecutive
+                # final_buf entry and _admit() found nothing runnable:
+                # this is a genuine stall, never a recoverable state.
+                raise RuntimeError(
+                    "streaming executor stalled: no runnable operator "
+                    f"and no in-flight work at block {next_yield}/"
+                    f"{total_blocks}")
+            done, rest = ray.wait(heads, num_returns=1, timeout=None)
+            if rest:
+                more, _ = ray.wait(rest, num_returns=len(rest), timeout=0)
+                done.extend(more)
+            _complete_batch(done)
+    finally:
+        _cancel_outstanding()

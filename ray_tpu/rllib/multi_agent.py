@@ -147,6 +147,28 @@ class MultiAgentRolloutWorker:
         self._obs, _ = self._env.reset(
             seed=int(self._rng.integers(2**31)))
         self._bufs: Dict[str, _AgentBuffer] = {}
+        # Rewards received BEFORE an agent's first action of the episode
+        # (turn-based envs): accrued here, folded into its next
+        # transition (already counted in the episode return).
+        self._pending_rew: Dict[str, float] = {}
+        # Sticky: set the first time a live agent sits a step out — i.e.
+        # the env has turn-based dynamics, so off-turn rewards are
+        # possible and horizon flushes must hold each agent's newest
+        # transition back.  Simultaneous-action envs never set it and
+        # keep the flush-everything path (no one-transition training
+        # lag, sample(1) is never empty).
+        self._turn_based = False
+        # Agents terminated THIS episode (cleared at reset): their
+        # absence from the action dict is early termination, not
+        # turn-taking, and must not flip the flag.
+        self._done_agents: set = set()
+        # Agents observed/rewarded THIS episode — the roster fallback
+        # for envs that don't declare ``agent_ids``: once an agent has
+        # appeared, it sitting a later step out is turn-taking evidence
+        # that survives horizon flushes (buffers may be empty).
+        # Per-episode (reset re-seeds it) so variable-roster
+        # simultaneous envs don't trip over last episode's cast.
+        self._seen_agents: set = set(self._obs)
         # Summed-over-agents return of the CURRENT episode; persists
         # across sample() horizons so only true episode ends record a
         # completed return (the single-agent worker's _ep_returns).
@@ -173,19 +195,37 @@ class MultiAgentRolloutWorker:
     def _flush_trajectories(self,
                             done_batches: Dict[str, List[SampleBatch]],
                             last_values: Dict[str, float],
-                            terminated: bool):
+                            terminated: bool, hold_last: bool = False):
         """GAE each agent's trajectory into its policy's bucket.
         ``last_values`` bootstraps truncated/horizon-cut trajectories.
-        Does NOT touch episode-return accounting — that belongs to true
-        episode ends only."""
+        ``hold_last`` (horizon cut mid-episode) keeps each agent's most
+        recent transition buffered instead of shipping it: a turn-based
+        env may pay that agent an off-turn reward on a LATER step (the
+        opponent's move deciding the game), which must land on a real
+        transition — the flushed prefix bootstraps from the held
+        transition's own value prediction, and the held row rides out
+        with the next flush.  Does NOT touch episode-return accounting —
+        that belongs to true episode ends only."""
+        kept: Dict[str, _AgentBuffer] = {}
         for agent_id, buf in self._bufs.items():
             if not len(buf):
                 continue
+            if hold_last:
+                held = {k: v.pop() for k, v in buf.cols.items()}
+                last_v = held[VF_PREDS]
+                nb = _AgentBuffer()
+                nb.add(held[OBS], held[ACTIONS], held[REWARDS],
+                       held[DONES], held[LOGP], held[VF_PREDS])
+                kept[agent_id] = nb
+                if not len(buf):
+                    continue
+            else:
+                last_v = 0.0 if terminated \
+                    else last_values.get(agent_id, 0.0)
             b = buf.to_batch()
-            last_v = 0.0 if terminated else last_values.get(agent_id, 0.0)
             b = compute_gae(b, last_v, self._gamma, self._lam)
             done_batches.setdefault(self._map(agent_id), []).append(b)
-        self._bufs = {}
+        self._bufs = kept
 
     def sample(self, num_env_steps: int) -> MultiAgentBatch:
         assert self._params, "set_weights first"
@@ -210,8 +250,32 @@ class MultiAgentRolloutWorker:
                     actions[a] = int(acts[i])
                     logps[a] = float(lp[i])
                     vfs[a] = float(values[i])
+            # A LIVE agent sitting a step out marks turn-based dynamics
+            # — detected both from the env's declared roster (works from
+            # step 1, before any buffer exists, so even sample(1)
+            # horizons see it) and from buffered agents absent from the
+            # action dict (envs without an ``agent_ids`` attribute).
+            # An agent whose last transition is done (or in
+            # _done_agents) merely terminated early (battle-royale style
+            # simultaneous envs): it is finished, not waiting its turn,
+            # and no further reward may arrive for it.  A live-but-idle
+            # agent is deliberately NOT excluded — this worker has no
+            # per-agent truncation, so an absent live agent may act (or
+            # be paid off-turn) later and the hold-back lag is the price
+            # of not dropping that reward.
+            if not self._turn_based:
+                roster = getattr(self._env, "agent_ids", None) \
+                    or self._seen_agents
+                if any(a not in actions and a not in self._done_agents
+                       for a in roster) or \
+                   any(a not in actions and a not in self._done_agents
+                       and len(buf) and not buf.cols[DONES][-1]
+                       for a, buf in self._bufs.items()):
+                    self._turn_based = True
             nobs, rews, terms, truncs, _ = self._env.step(actions)
             env_steps += 1
+            self._seen_agents.update(nobs)
+            self._seen_agents.update(a for a in rews if a != ALL_DONE)
             all_term = terms.get(ALL_DONE, False)
             all_trunc = truncs.get(ALL_DONE, False)
             for a, act in actions.items():
@@ -220,30 +284,89 @@ class MultiAgentRolloutWorker:
                 # bootstraps from its final obs below.
                 agent_term = terms.get(a, False) or all_term
                 self._bufs.setdefault(a, _AgentBuffer()).add(
-                    self._obs[a], act, float(rews.get(a, 0.0)),
+                    self._obs[a], act,
+                    float(rews.get(a, 0.0)) + self._pending_rew.pop(a, 0.0),
                     bool(agent_term), logps[a], vfs[a])
                 self._ep_reward_sum += float(rews.get(a, 0.0))
+            # Turn-based envs reward agents on steps they did NOT act
+            # (e.g. the opponent's move decides the game): credit those
+            # rewards to the agent's buffered LAST transition — or accrue
+            # them for its next one if it hasn't acted yet — so terminal
+            # rewards reach both the trajectory (GAE sees them) and the
+            # episode-return accounting, instead of being dropped with
+            # the action dict.
+            for a, r in rews.items():
+                if a in actions or a == ALL_DONE or not r:
+                    continue
+                self._ep_reward_sum += float(r)
+                if a not in self._done_agents:
+                    # A reward paid to a live non-acting agent IS
+                    # turn-based dynamics (the definitive signal for
+                    # envs with no ``agent_ids`` roster); a posthumous
+                    # reward to an early-terminated agent is not.
+                    self._turn_based = True
+                buf = self._bufs.get(a)
+                if buf is not None and len(buf):
+                    buf.cols[REWARDS][-1] += float(r)
+                else:
+                    self._pending_rew[a] = \
+                        self._pending_rew.get(a, 0.0) + float(r)
+            # Off-turn TERMINATION — with or without a reward riding the
+            # same step — must mark the agent's buffered last transition
+            # done (GAE must not bootstrap past the end of its
+            # trajectory); a zero/absent reward skips the credit loop
+            # above, so the done flag is handled here for all of them.
+            for a, buf in self._bufs.items():
+                if a in actions or not len(buf):
+                    continue
+                if terms.get(a, False) or all_term:
+                    buf.cols[DONES][-1] = True
+            for a, t in terms.items():
+                if t and a != ALL_DONE:
+                    self._done_agents.add(a)
             if all_term or all_trunc:
                 if all_trunc and not all_term:
                     # Time-limit truncation: bootstrap from the final
-                    # obs the env just returned.
-                    self._flush_trajectories(
-                        done_batches, self._values_of(nobs),
-                        terminated=False)
+                    # obs the env just returned.  A turn-based env only
+                    # returns the next-turn agent's obs, so off-turn
+                    # agents fall back to their last recorded value
+                    # prediction (the same proxy hold_last uses) rather
+                    # than a flat 0.0 that would bias their advantages.
+                    vals = self._values_of(nobs)
+                    for a, buf in self._bufs.items():
+                        if a not in vals and len(buf):
+                            vals[a] = float(buf.cols[VF_PREDS][-1])
+                    self._flush_trajectories(done_batches, vals,
+                                             terminated=False)
                 else:
                     self._flush_trajectories(done_batches, {},
                                              terminated=True)
                 self._completed_returns.append(self._ep_reward_sum)
                 self._ep_reward_sum = 0.0
+                # Accrued rewards of agents that never acted this episode
+                # have no transition to land on; they were counted in the
+                # return above and must not leak into the next episode.
+                self._pending_rew.clear()
+                self._done_agents.clear()
                 nobs, _ = self._env.reset()
+                self._seen_agents = set(nobs)
             self._obs = nobs
-        # Sample horizon hit mid-episode: flush for training with a
-        # current-obs bootstrap, WITHOUT recording an episode return
-        # (the episode continues into the next sample() call).
+        # Sample horizon hit mid-episode: flush for training WITHOUT
+        # recording an episode return (the episode continues into the
+        # next sample() call).  Under turn-based dynamics each agent's
+        # newest transition stays buffered (hold_last) so an off-turn
+        # terminal reward arriving next sample() still reaches a
+        # trajectory — the prefix bootstraps from that transition's
+        # recorded value; simultaneous-action envs flush everything with
+        # a current-obs bootstrap as before.
         if self._bufs:
-            self._flush_trajectories(done_batches,
-                                     self._values_of(self._obs),
-                                     terminated=False)
+            if self._turn_based:
+                self._flush_trajectories(done_batches, {},
+                                         terminated=False, hold_last=True)
+            else:
+                self._flush_trajectories(done_batches,
+                                         self._values_of(self._obs),
+                                         terminated=False)
         merged = {pid: concat_batches(parts)
                   for pid, parts in done_batches.items() if parts}
         return MultiAgentBatch(merged, env_steps)

@@ -323,6 +323,40 @@ def test_pull_registry_lock_order_convention(checker):
     checker.assert_acyclic()
 
 
+def test_streaming_stats_lock_convention(checker):
+    """data/streaming_executor.StreamingStats._lock's documented
+    convention: an independent LEAF — the executor's dispatch loop is
+    single-threaded and the lock only guards counter snapshots read by
+    Dataset.stats(), so it is never held across submission/wait/get and
+    NO other lock is acquired under it.  The recorded acquisition graph
+    must show zero outgoing edges from the stats lock across the
+    row-create/update/snapshot paths."""
+    from ray_tpu.data.streaming_executor import StreamingStats
+
+    stats = StreamingStats(budget_bytes=1 << 20, inflight_cap=4)
+    assert isinstance(stats._lock, lockcheck._LockProxy)
+    row = stats.op_row("map+filter")
+    with stats._lock:
+        row["inflight"] += 1
+        stats.admitted_tasks += 1
+    stats.note_live_bytes(512)
+    # Concurrent reader (the Dataset.stats() shape) while the "executor
+    # thread" keeps mutating.
+    got = []
+    reader = threading.Thread(target=lambda: got.append(stats.summary()))
+    reader.start()
+    stats.note_live_bytes(1024)
+    reader.join(timeout=5)
+    assert got and got[0]["admitted_tasks"] == 1
+    assert stats.summary()["peak_inflight_bytes"] == 1024
+    stats_site = stats._lock._site
+    edges = checker.edges()
+    assert edges.get(stats_site, set()) == set(), (
+        f"a lock was acquired while holding the streaming-stats lock: "
+        f"{edges.get(stats_site)}")
+    checker.assert_acyclic()
+
+
 def test_shm_store_copy_pool_lock_convention(checker, monkeypatch,
                                              tmp_path):
     """shm_store's documented convention: the module copy-pool lock and

@@ -182,3 +182,318 @@ def test_is_wis_estimators(tmp_path):
     assert wis_est["v_target"] > wis_est["v_behavior"]
     assert is_est["v_target"] > is_est["v_behavior"]
     assert wis_est["v_gain"] > 1.05
+
+
+class TurnBasedDuel(MultiAgentEnv):
+    """Strictly turn-based: exactly ONE agent acts per step (only it
+    appears in the obs dict), but the env pays BOTH agents a reward on
+    every step — the non-acting agent's reward arrives on a step where
+    it has no entry in the action dict, the exact shape that used to be
+    dropped from trajectories and episode returns."""
+
+    HORIZON = 6
+    agent_ids = ["a0", "a1"]
+
+    class _Box:
+        shape = (4,)
+
+    class _Disc:
+        n = 4
+
+    observation_space = _Box()
+    action_space = _Disc()
+
+    def __init__(self):
+        self._t = 0
+
+    def _obs_for(self, aid):
+        vec = np.zeros(4, np.float32)
+        vec[int(aid[-1])] = 1.0
+        return {aid: vec}
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        return self._obs_for("a0"), {}
+
+    def step(self, action_dict):
+        assert list(action_dict) == [self.agent_ids[self._t % 2]]
+        self._t += 1
+        done = self._t >= self.HORIZON
+        # Acting agent earns 1.0; the OTHER (non-acting) agent earns 0.5
+        # this same step — deliverable only via its last transition.
+        actor = self.agent_ids[(self._t - 1) % 2]
+        other = self.agent_ids[self._t % 2]
+        rews = {actor: 1.0, other: 0.5}
+        terms = {a: done for a in self.agent_ids}
+        terms["__all__"] = done
+        return (self._obs_for(self.agent_ids[self._t % 2]), rews, terms,
+                {"__all__": False}, {})
+
+
+def test_turn_based_rewards_credit_non_acting_agents():
+    """Rewards returned for agents absent from the action dict must fold
+    into their buffered last transition (trajectory) AND the episode
+    return — a turn-based env's terminal rewards otherwise vanish."""
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+
+    worker_cls = MultiAgentRolloutWorker._cls  # in-process, no cluster
+    w = worker_cls(TurnBasedDuel,
+                   {"p": {"obs_dim": 4, "num_actions": 4}},
+                   lambda aid: "p", seed=0)
+    import jax
+
+    from ray_tpu.rllib.models import ActorCriticMLP
+
+    w.set_weights({"p": ActorCriticMLP(obs_dim=4, num_actions=4).init(
+        jax.random.PRNGKey(0))})
+    batch = w.sample(TurnBasedDuel.HORIZON)  # exactly one episode
+    # Each step hands out 1.0 + 0.5; a0 never receives a1's final-step
+    # 0.5 unless non-acting credit works.  Episode return = 6 * 1.5.
+    returns = w.episode_returns()
+    assert returns == [pytest.approx(TurnBasedDuel.HORIZON * 1.5)], returns
+    # Trajectory-level: each agent acted HORIZON/2 times and every
+    # waiting-step 0.5 landed on a transition (a1's first carries the
+    # pre-first-action accrual AND the 0.5 earned right after it ->
+    # 2.0; its last has no later waiting step -> 1.0).  Nothing of the
+    # 9.0 total is dropped.
+    b = batch["p"]
+    assert len(b) == TurnBasedDuel.HORIZON  # 3 transitions per agent
+    assert b[REWARDS].sum() == pytest.approx(9.0)
+    np.testing.assert_allclose(np.sort(b[REWARDS]),
+                               [1.0, 1.5, 1.5, 1.5, 1.5, 2.0])
+
+
+def test_terminal_reward_after_horizon_flush_reaches_trajectory():
+    """The sample horizon splitting an agent's last action from its
+    off-turn terminal reward must not drop the reward: the horizon flush
+    holds each agent's newest transition buffered, so the opponent's
+    game-ending move in the NEXT sample() still credits a real
+    transition (and flips its done flag) instead of evaporating with
+    the episode reset."""
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+
+    worker_cls = MultiAgentRolloutWorker._cls  # in-process, no cluster
+    w = worker_cls(TurnBasedDuel,
+                   {"p": {"obs_dim": 4, "num_actions": 4}},
+                   lambda aid: "p", seed=0)
+    import jax
+
+    from ray_tpu.rllib.models import ActorCriticMLP
+
+    w.set_weights({"p": ActorCriticMLP(obs_dim=4, num_actions=4).init(
+        jax.random.PRNGKey(0))})
+    # Steps 1..5: episode NOT done; a0's last action (step 5) would be
+    # flushed here, before its terminal 0.5 arrives on step 6.
+    b1 = w.sample(TurnBasedDuel.HORIZON - 1)
+    # Step 6: a1 acts, game ends, a0 is paid 0.5 off-turn.
+    b2 = w.sample(1)
+    assert w.episode_returns() == \
+        [pytest.approx(TurnBasedDuel.HORIZON * 1.5)]
+    # Held-back transitions ship with the terminal flush: 3 + 3 rows,
+    # and the full 9.0 reaches trajectories across the two batches.
+    assert len(b1["p"]) == 3 and len(b2["p"]) == 3
+    total = b1["p"][REWARDS].sum() + b2["p"][REWARDS].sum()
+    assert total == pytest.approx(9.0)
+    # a0's held transition carries 1.0 (its action) + 0.5 (terminal,
+    # off-turn) and is marked done; a1's held row stays mid-episode.
+    np.testing.assert_allclose(np.sort(b2["p"][REWARDS]),
+                               [1.0, 1.5, 1.5])
+    assert b2["p"][DONES].sum() == 2  # a0 held + a1's acting row
+    assert not b1["p"][DONES].any()
+
+
+def test_turn_based_sample1_horizons_keep_terminal_rewards():
+    """Turn-based detection must not depend on a buffered agent
+    surviving into the next step: with sample(1) horizons every flush
+    empties the buffers, so the env's declared roster (an agent absent
+    from the action dict from step 1) is what flips the flag — and the
+    full 9.0 still reaches trajectories across the six one-step
+    batches."""
+    import jax
+
+    from ray_tpu.rllib.models import ActorCriticMLP
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+
+    worker_cls = MultiAgentRolloutWorker._cls
+    w = worker_cls(TurnBasedDuel,
+                   {"p": {"obs_dim": 4, "num_actions": 4}},
+                   lambda aid: "p", seed=0)
+    w.set_weights({"p": ActorCriticMLP(obs_dim=4, num_actions=4).init(
+        jax.random.PRNGKey(0))})
+    batches = [w.sample(1) for _ in range(TurnBasedDuel.HORIZON)]
+    assert w._turn_based  # roster signal: a1 absent on step 1
+    assert w.episode_returns() == \
+        [pytest.approx(TurnBasedDuel.HORIZON * 1.5)]
+    total = sum(float(b["p"][REWARDS].sum()) for b in batches
+                if "p" in b.policy_batches)
+    rows = sum(len(b["p"]) for b in batches
+               if "p" in b.policy_batches)
+    assert rows == TurnBasedDuel.HORIZON
+    assert total == pytest.approx(9.0)
+
+
+def test_simultaneous_env_horizon_flush_holds_nothing():
+    """hold_last is gated on turn-based dynamics: a simultaneous-action
+    env (every agent acts every step, off-turn rewards impossible) keeps
+    the flush-everything horizon path — sample(1) returns both agents'
+    transitions immediately, never an empty batch nor a one-transition
+    training lag."""
+    import jax
+
+    from ray_tpu.rllib.models import ActorCriticMLP
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+
+    worker_cls = MultiAgentRolloutWorker._cls
+    w = worker_cls(TwoAgentMatch,
+                   {"p": {"obs_dim": 4, "num_actions": 4}},
+                   lambda aid: "p", seed=0)
+    w.set_weights({"p": ActorCriticMLP(obs_dim=4, num_actions=4).init(
+        jax.random.PRNGKey(0))})
+    b = w.sample(1)  # horizon cut after one simultaneous step
+    assert len(b["p"]) == 2  # one transition per agent, nothing held
+
+
+class RosterlessDuel(TurnBasedDuel):
+    """Turn-based like the parent but (a) declares no ``agent_ids``
+    roster and (b) pays the off-turn agent ONLY at game end — so
+    neither the env's roster nor an early off-turn reward can flip the
+    turn-based flag; only the seen-agents fallback can."""
+
+    agent_ids = ()
+    _CAST = ("a0", "a1")
+
+    def step(self, action_dict):
+        self._t += 1
+        done = self._t >= self.HORIZON
+        actor = self._CAST[(self._t - 1) % 2]
+        rews = {actor: 1.0}
+        if done:
+            rews[self._CAST[self._t % 2]] = 3.0  # terminal, off-turn
+        terms = {a: done for a in self._CAST}
+        terms["__all__"] = done
+        return (self._obs_for(self._CAST[self._t % 2]), rews, terms,
+                {"__all__": False}, {})
+
+
+def test_rosterless_env_seen_agents_fallback_keeps_terminal_reward():
+    """Without a declared roster, agents OBSERVED this episode form the
+    fallback roster: a0 sitting out step 2 flips the flag, so the
+    horizon flush before the final step holds its newest transition and
+    the off-turn terminal 3.0 still reaches a trajectory."""
+    import jax
+
+    from ray_tpu.rllib.models import ActorCriticMLP
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+
+    worker_cls = MultiAgentRolloutWorker._cls
+    w = worker_cls(RosterlessDuel,
+                   {"p": {"obs_dim": 4, "num_actions": 4}},
+                   lambda aid: "p", seed=0)
+    w.set_weights({"p": ActorCriticMLP(obs_dim=4, num_actions=4).init(
+        jax.random.PRNGKey(0))})
+    b1 = w.sample(RosterlessDuel.HORIZON - 1)
+    assert w._turn_based  # flipped by the seen-agents roster at step 2
+    b2 = w.sample(1)
+    assert w.episode_returns() == [pytest.approx(9.0)]  # 6x1.0 + 3.0
+    total = sum(float(b["p"][REWARDS].sum()) for b in (b1, b2)
+                if "p" in b.policy_batches)
+    assert total == pytest.approx(9.0)
+
+
+def test_turn_based_truncation_bootstraps_off_turn_agents():
+    """Time-limit truncation mid-game: the off-turn agent (absent from
+    the final obs dict) must bootstrap from its last recorded value
+    prediction, not a flat 0.0 that biases its advantages."""
+    import jax
+
+    from ray_tpu.rllib.models import ActorCriticMLP
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+    from ray_tpu.rllib.sample_batch import ADVANTAGES, VF_PREDS
+
+    class TruncatedDuel(TurnBasedDuel):
+        def step(self, action_dict):
+            obs, rews, terms, truncs, info = super().step(action_dict)
+            if self._t >= 3:  # time limit BEFORE the game decides
+                terms = {a: False for a in terms}
+                truncs = {"__all__": True}
+            return obs, rews, terms, truncs, info
+
+    worker_cls = MultiAgentRolloutWorker._cls
+    gamma = 0.97
+    w = worker_cls(TruncatedDuel,
+                   {"a0": {"obs_dim": 4, "num_actions": 4},
+                    "a1": {"obs_dim": 4, "num_actions": 4}},
+                   lambda aid: aid, seed=0, gamma=gamma)
+    params = ActorCriticMLP(obs_dim=4, num_actions=4).init(
+        jax.random.PRNGKey(0))
+    w.set_weights({"a0": params, "a1": params})
+    b = w.sample(3)  # steps 1-3; truncation after step 3, a0 off-turn
+    a0 = b["a0"]
+    # Last-row GAE delta with the VF bootstrap: r + g*vf - vf (done
+    # False); with the old 0.0 bootstrap it would be r - vf.
+    r, vf, adv = (float(a0[REWARDS][-1]), float(a0[VF_PREDS][-1]),
+                  float(a0[ADVANTAGES][-1]))
+    assert adv == pytest.approx(r + gamma * vf - vf, abs=1e-5)
+
+
+class EarlyDropout(MultiAgentEnv):
+    """Simultaneous-action env where a1 terminates on step 1 while the
+    episode (and a0) continues: a1 then sits in the buffers without
+    acting, which must NOT read as turn-based dynamics — its trajectory
+    is done, not waiting a turn."""
+
+    HORIZON = 4
+    agent_ids = ["a0", "a1"]
+
+    class _Box:
+        shape = (4,)
+
+    class _Disc:
+        n = 4
+
+    observation_space = _Box()
+    action_space = _Disc()
+
+    def __init__(self):
+        self._t = 0
+
+    def _obs(self, agents):
+        return {a: np.zeros(4, np.float32) for a in agents}
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        return self._obs(self.agent_ids), {}
+
+    def step(self, action_dict):
+        self._t += 1
+        done = self._t >= self.HORIZON
+        rews = {a: 1.0 for a in action_dict}
+        terms = {"a0": done, "a1": True, "__all__": done}
+        live = ["a0"] if not done else []
+        return self._obs(live), rews, terms, {"__all__": False}, {}
+
+
+def test_early_terminated_agent_does_not_mark_turn_based():
+    """An agent that terminated early in a simultaneous env must not
+    flip the sticky turn-based flag: horizon flushes keep shipping every
+    transition immediately (no hold-back lag) because no off-turn reward
+    can ever arrive for a finished agent."""
+    import jax
+
+    from ray_tpu.rllib.models import ActorCriticMLP
+    from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+
+    worker_cls = MultiAgentRolloutWorker._cls
+    w = worker_cls(EarlyDropout,
+                   {"p": {"obs_dim": 4, "num_actions": 4}},
+                   lambda aid: "p", seed=0)
+    w.set_weights({"p": ActorCriticMLP(obs_dim=4, num_actions=4).init(
+        jax.random.PRNGKey(0))})
+    # Steps 1-2: a1 dies on step 1, a0 plays on.  The horizon flush
+    # after step 2 must ship ALL three transitions (a0 x2 + a1 x1).
+    b1 = w.sample(2)
+    assert not w._turn_based
+    assert len(b1["p"]) == 3
+    # Steps 3-4 end the episode; every step paid 1.0 per acting agent.
+    w.sample(2)
+    assert w.episode_returns() == [pytest.approx(5.0)]

@@ -43,6 +43,8 @@ def test_task_imports_package_absent_from_driver(cluster, local_pkg):
     assert ray.get(probe.remote(), timeout=120) == "pip-env-works"
 
 
+@pytest.mark.slow  # ~27s (venv build); the basic pip-env path keeps a
+                   # tier-1 representative in the test above
 def test_venv_cached_across_tasks_and_plain_tasks_unaffected(cluster,
                                                              local_pkg):
     env = {"pip": {"packages": [local_pkg],

@@ -60,6 +60,8 @@ def test_hyperband_promotes_best_and_stops_losers(cluster):
     assert total < len(targets) * 16 * 0.75, iters  # real savings
 
 
+@pytest.mark.slow  # ~15s; early-stopping coverage rides tier-1's
+                   # hyperband test, making this the duplicate
 def test_median_stopping_rule_stops_bad_trials(cluster):
     targets = [0.1, 0.15, 0.9, 0.85, 0.8]
     # Reporting order is load-dependent on a small box: if both bad
@@ -110,6 +112,8 @@ def test_tpe_searcher_beats_random_on_quadratic():
     assert model_err < startup_err, (startup_err, model_err)
 
 
+@pytest.mark.slow  # ~34s; TPE logic has two fast in-process tests here
+                   # and tune.run wiring is covered by test_tune.py
 def test_tpe_through_tune_run_receives_observations(cluster):
     """The runner must key suggest() and on_trial_complete() by the SAME
     trial id, or model-based searchers never see an observation."""
