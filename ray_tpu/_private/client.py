@@ -128,6 +128,16 @@ def client_connect(address: str, authkey: bytes,
             rt.deliver_reply(m[1], m[2])
         elif tag == "reply":
             rt.deliver_reply(m[1], m[2])
+        elif tag == "lease_grant":
+            # Unsolicited bulk grant piggybacked on this client's
+            # head-brokered submit burst; adopt off the reader thread
+            # (adoption dials the granted workers).
+            threading.Thread(
+                target=rt.direct.adopt_grant,
+                args=(m[1], m[2], m[3], m[4], m[5]),
+                daemon=True, name="ray_tpu-client-lease").start()
+        elif tag == "lease_revoke":
+            rt.direct.revoke(m[1])
 
     def reader():
         while True:
@@ -147,6 +157,10 @@ def client_connect(address: str, authkey: bytes,
             _t.sleep(0.25)
             try:
                 rt.flush_decrefs()
+                # Lease-plane counter deltas (leased_submits/spillbacks):
+                # a client drives direct pushes too and its counters feed
+                # the same head-side transfer_stats aggregation.
+                rt.flush_xfer_stats()
             except Exception:
                 return
 

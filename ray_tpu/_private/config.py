@@ -111,6 +111,35 @@ class Config:
     # CPU count (min 1, fallback 8 when it cannot be read).
     data_max_inflight_tasks: int = 0
 
+    # --- Decentralized dispatch (reference: the raylet's lease-based
+    # hybrid scheduling, RequestWorkerLease + spillback in
+    # local_task_manager.h:58, with task metadata owned by the submitting
+    # worker — Ownership, NSDI'21).  Master switch for the lease-grant
+    # scheduling plane: bulk lease grants piggybacked on head-brokered
+    # submit bursts, holder-side renewal batching, executor spillback,
+    # lease revocation on node death, and the head's sharded/deferred
+    # dispatch passes.  Off = the pre-existing head-brokered path,
+    # byte-identical, with every decentralized-dispatch counter zero. ---
+    decentralized_dispatch: bool = True
+    # Execution slots per granted lease: the holder pipelines at most this
+    # many unacked pushes onto one leased worker (capped by
+    # max_tasks_in_flight_per_worker at grant time).
+    lease_slots: int = 8
+    # Lease time-to-live: the head revokes (and retires) a client-leased
+    # worker whose holder has not renewed within this window — the
+    # holder's liveness signal, since pushed tasks never touch the head.
+    # 0 disables TTL expiry (leases then end only via return/death).
+    lease_ttl_s: float = 15.0
+    # Holder-side renewal cadence: one ("lease_renew", ...) message per
+    # this many leased pushes (plus a periodic renew for long tasks) —
+    # the "one message per N tasks" amortization.
+    lease_renew_tasks: int = 64
+    # Executor-side spillback: a pushed (spill-eligible) task arriving
+    # while the worker's local queue is at least this deep bounces back
+    # to the holder with a next-best-node hint instead of queueing
+    # (reference: hybrid policy spillback).  0 disables spillback.
+    lease_spillback_depth: int = 32
+
     # Seconds a worker may sit idle before the pool reaps it (reference:
     # idle worker killing in worker_pool.cc).
     idle_worker_timeout_s: float = 300.0

@@ -47,6 +47,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import protocol, serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu import exceptions as exc
 
@@ -56,11 +57,14 @@ READY = 1
 ERRORED = 2
 DELEGATED = 3  # handed to the head (exported or rerouted); head is authority
 
-PIPELINE_DEPTH = 8       # max unacked pushes per leased worker
+PIPELINE_DEPTH = 8       # default unacked pushes per leased worker (a v1
+#                          lease grant overrides this with its slot count)
 MAX_LEASES_PER_REQ = 8
 LEASE_LINGER_S = 0.2     # idle time before a lease is returned to the head
 REROUTE_CHUNK = 32       # specs sent via the head per failed lease round
 ACTOR_PIPELINE = 64      # max unacked direct pushes per actor channel
+SPILL_MAX = 3            # spillbacks before an entry reroutes to the head
+SATURATED_S = 0.1        # how long a spilled-off lease is deprioritized
 
 
 class OwnedState:
@@ -90,9 +94,11 @@ class _Lease:
 
     __slots__ = ("worker_id", "addr", "conn", "send_lock", "inflight",
                  "funcs_sent", "dead", "idle_since", "klass",
-                 "outbuf", "buf_lock")
+                 "outbuf", "buf_lock", "node_hex", "slots", "pushed",
+                 "last_renew", "saturated_until", "ttl")
 
-    def __init__(self, worker_id: str, addr, klass):
+    def __init__(self, worker_id: str, addr, klass, node_hex=None,
+                 slots=PIPELINE_DEPTH, ttl=0.0):
         self.worker_id = worker_id
         self.addr = addr
         self.conn = None
@@ -102,6 +108,19 @@ class _Lease:
         self.dead = False
         self.idle_since = time.monotonic()
         self.klass = klass
+        # Lease-plane state (decentralized dispatch): the granting node,
+        # the granted execution-slot count (pipeline bound for THIS
+        # lease), the GRANTED renewal TTL (authoritative — the head's
+        # reaper expires against its own clock, so renewal cadence must
+        # come from the grant, never this process's local config; 0 =
+        # legacy grant, no renewals), pushes since the last renewal, and
+        # the spillback deprioritization deadline.
+        self.node_hex = node_hex
+        self.slots = max(1, slots)
+        self.ttl = float(ttl or 0.0)
+        self.pushed = 0
+        self.last_renew = time.monotonic()
+        self.saturated_until = 0.0
         # Conflation-sender buffer: pushes append here (buf_lock only)
         # while a flush's pickle+write runs under send_lock — appenders
         # never block on an in-flight write, which is what lets batches
@@ -181,6 +200,19 @@ class DirectCaller:
         self._lease_dirty_lock = threading.Lock()
         self._send_event = threading.Event()
         self._sender_thread = None
+        # Decentralized-dispatch holder counters, shipped to the head in
+        # the periodic xfer_stats deltas (zero while the switch is off):
+        # leased_submits = specs pushed over leases (the traffic the head
+        # never sees), spillbacks = pushes an oversubscribed executor
+        # bounced back.
+        self.leased_submits = 0
+        self.spillbacks = 0
+
+    def stats(self) -> Dict[str, int]:
+        """Counter snapshot for the xfer_stats delta shipper."""
+        with self.lock:
+            return {"leased_submits": self.leased_submits,
+                    "spillbacks": self.spillbacks}
 
     # ------------------------------------------------------------- owned --
     def register_put(self, oid: ObjectID, descr, nested_local, nested_head):
@@ -442,9 +474,19 @@ class DirectCaller:
     # -------------------------------------------------------------- pump --
     def _pump(self, klass):
         """Push queued specs onto leases with free pipeline slots; request
-        more leases (or fall back to the head) when short."""
+        more leases (or fall back to the head) when short.
+
+        Lease plane: each lease is bounded by its GRANTED slot count (the
+        head capped it at max_tasks_in_flight_per_worker), a recently
+        spilled-off lease is throttled to a trickle while its
+        saturation window runs (the bulk diverts to other leases or a
+        hint-steered request), and the TTL renewal rides out of the same
+        pass — one ("lease_renew", ...) per lease_renew_tasks pushes, not
+        one per task."""
+        cfg = GLOBAL_CONFIG
         to_push: List[Tuple[_Lease, dict]] = []
         need_leases = 0
+        renew: List[str] = []
         with self.lock:
             pool = self.pools.get(klass)
             if pool is None:
@@ -452,10 +494,13 @@ class DirectCaller:
             leases = [l for l in pool["leases"] if not l.dead]
             pool["leases"] = leases
             q = pool["queue"]
+            now = time.monotonic()
             while q:
                 lease = None
                 for cand in leases:
-                    if len(cand.inflight) < PIPELINE_DEPTH:
+                    cap = (1 if now < cand.saturated_until
+                           else cand.slots)
+                    if len(cand.inflight) < cap:
                         lease = cand
                         break
                 if lease is None:
@@ -465,19 +510,30 @@ class DirectCaller:
                 entry["rid"] = rid
                 lease.inflight[rid] = entry
                 lease.idle_since = None
+                lease.pushed += 1
+                if lease.ttl > 0 and lease.pushed >= max(
+                        1, cfg.lease_renew_tasks):
+                    lease.pushed = 0
+                    lease.last_renew = now
+                    renew.append(lease.worker_id)
                 to_push.append((lease, entry))
+            if cfg.decentralized_dispatch:
+                self.leased_submits += len(to_push)
             if q and not pool["requesting"]:
-                now = time.monotonic()
                 if now - pool["last_req"] > 0.05 or not leases:
                     pool["requesting"] = True
                     pool["last_req"] = now
                     need_leases = min(MAX_LEASES_PER_REQ,
                                       max(1, len(q) // PIPELINE_DEPTH))
+            if renew:
+                self._outbound.append(("head", ("lease_renew", renew)))
         by_lease: Dict[int, Tuple[_Lease, list]] = {}
         for lease, entry in to_push:
             by_lease.setdefault(id(lease), (lease, []))[1].append(entry)
         for lease, entries in by_lease.values():
             self._push_group(lease, entries)
+        if renew:
+            self._flush_outbound()
         if need_leases:
             threading.Thread(
                 target=self._request_leases, args=(klass, need_leases),
@@ -489,10 +545,21 @@ class DirectCaller:
         per-task sends made the push path syscall- and pickle-bound
         under multi-client load (reference: gRPC stream write coalescing
         on the PushTask stream)."""
+        cfg = GLOBAL_CONFIG
+        # Spillback is opt-in PER PUSH (capability gate): only tasks the
+        # caller marks may bounce — an executor never spills a push whose
+        # sender would not understand the ("dspill", ...) reply.  Actor
+        # channels never spill (per-caller ordering).
+        spill_ok = (cfg.decentralized_dispatch
+                    and cfg.lease_spillback_depth > 0
+                    and not (lease.klass and lease.klass[0] == "actor"))
         tasks, failed = [], []
         for entry in entries:
             try:
-                tasks.append((entry, self._build_task(entry["spec"])))
+                task = self._build_task(entry["spec"])
+                if spill_ok:
+                    task["_spill_ok"] = True
+                tasks.append((entry, task))
             except exc.RayTpuError as e:
                 failed.append((entry, e))
         if failed:
@@ -672,8 +739,7 @@ class DirectCaller:
                 ch["lease"] = lease
                 ch["state"] = "direct"
         if queued is not None:
-            for e in queued:
-                self._reroute_to_head(e)
+            self._reroute_many(queued)
             return
         threading.Thread(target=self._lease_reader, args=(lease,),
                          daemon=True).start()
@@ -710,8 +776,8 @@ class DirectCaller:
                     entry["rid"] = rid
                     lease.inflight[rid] = entry
                     to_push.append((lease, entry))
-        for entry in to_head:
-            self._reroute_to_head(entry)
+        if to_head:
+            self._reroute_many(to_head)
         if to_push:
             self._push_group(to_push[0][0], [e for _, e in to_push])
 
@@ -757,32 +823,48 @@ class DirectCaller:
         for entry in inflight:
             self._fail_entry(entry, exc.ActorDiedError(
                 "Actor worker connection lost (direct channel)"))
-        for entry in queued:
-            self._reroute_to_head(entry)
+        self._reroute_many(queued)
 
     # ------------------------------------------------------------ leases --
     def _request_leases(self, klass, n):
         pool = None
+        cfg = GLOBAL_CONFIG
+        hint = None
+        if cfg.decentralized_dispatch:
+            with self.lock:
+                p = self.pools.get(klass)
+                if p is not None:
+                    # One-shot spillback hint: steer this request toward
+                    # the node the head named as next-best.
+                    hint = p.pop("hint", None)
         try:
             res = dict(klass)
-            reply = self.host.head_request(
-                lambda rid: ("lease_req", rid, res, n))
+            if cfg.decentralized_dispatch:
+                opts = {"v": 1}
+                if hint:
+                    opts["hint"] = hint
+                reply = self.host.head_request(
+                    lambda rid: ("lease_req", rid, res, n, opts))
+            else:
+                reply = self.host.head_request(
+                    lambda rid: ("lease_req", rid, res, n))
         except Exception:
             reply = []
-        granted: List[_Lease] = []
-        for wid, addr in (reply or []):
-            lease = _Lease(wid, addr, klass)
-            try:
-                # Dial here, once, before the lease is visible to _pump:
-                # the reader thread and pushers then share one connection.
-                lease.conn = self.host.dial(addr)
-            except Exception:
-                try:
-                    self.host.head_send(("lease_return", [wid]))
-                except Exception:
-                    pass
-                continue
-            granted.append(lease)
+        slots, ttl = PIPELINE_DEPTH, 0.0
+        if isinstance(reply, dict):
+            # v1 grant: per-worker node ids + slot count + TTL + the
+            # next-best-node hint for a future spillback.
+            slots = int(reply.get("slots") or PIPELINE_DEPTH)
+            ttl = float(reply.get("ttl") or 0.0)
+            if reply.get("hint"):
+                with self.lock:
+                    p = self.pools.get(klass)
+                    if p is not None:
+                        p.setdefault("hint", reply["hint"])
+            rows = reply.get("grants") or []
+        else:
+            rows = [(wid, addr, None) for wid, addr in (reply or [])]
+        granted = self._dial_grants(klass, rows, slots, ttl)
         with self.lock:
             pool = self.pools.get(klass)
             if pool is None:
@@ -805,8 +887,8 @@ class DirectCaller:
         for lease in granted:
             threading.Thread(target=self._lease_reader, args=(lease,),
                              daemon=True).start()
-        for entry in stranded:
-            self._reroute_to_head(entry)
+        if stranded:
+            self._reroute_many(stranded)
         if granted:
             self._pump(klass)
             self._ensure_linger_thread()
@@ -815,6 +897,103 @@ class DirectCaller:
             # lease request goes out (no submit/result event will — the
             # caller may already be parked in ray.get).
             self._pump(klass)
+
+    def _dial_grants(self, klass, rows, slots, ttl) -> List["_Lease"]:
+        """Granted (wid, addr, node_hex) rows -> dialed _Lease objects
+        (the shared adoption core of solicited replies and unsolicited
+        lease_grant pushes).  Dial happens here, once, before the lease
+        is visible to _pump: the reader thread and pushers then share
+        one connection.  A failed dial returns that lease to the head
+        immediately."""
+        granted: List[_Lease] = []
+        for wid, addr, node_hex in rows or []:
+            lease = _Lease(wid, addr, klass, node_hex=node_hex,
+                           slots=int(slots or PIPELINE_DEPTH),
+                           ttl=float(ttl or 0.0))
+            try:
+                lease.conn = self.host.dial(addr)
+            except Exception:
+                try:
+                    self.host.head_send(("lease_return", [wid]))
+                except Exception:
+                    pass
+                continue
+            granted.append(lease)
+        return granted
+
+    def adopt_grant(self, klass_items, grants, slots, ttl, hint):
+        """Adopt an UNSOLICITED bulk lease grant the head piggybacked on
+        a head-brokered submit burst (("lease_grant", ...)): dial the
+        granted workers and fold them into the matching pool so the next
+        burst pushes direct.  Runs off the reader thread (dials block).
+        Unused grants return via the normal linger path."""
+        klass = tuple((k, float(v)) for k, v in klass_items)
+        granted = self._dial_grants(klass, grants, slots, ttl)
+        if not granted:
+            return
+        with self.lock:
+            pool = self._pool_locked(klass)
+            pool["leases"].extend(granted)
+            if hint:
+                pool.setdefault("hint", hint)
+        for lease in granted:
+            threading.Thread(target=self._lease_reader, args=(lease,),
+                             daemon=True).start()
+        self._pump(klass)
+        self._ensure_linger_thread()
+
+    def revoke(self, worker_ids):
+        """Head-initiated lease revocation (("lease_revoke", ...): node/
+        worker death or TTL expiry).  The lease-death path reroutes or
+        retries everything the lease still carried — same semantics as
+        discovering the death via conn EOF, minus the wait."""
+        wids = set(worker_ids)
+        with self.lock:
+            doomed = [l for p in self.pools.values() for l in p["leases"]
+                      if l.worker_id in wids and not l.dead]
+            for ch in self.actor_channels.values():
+                lease = ch.get("lease")
+                if lease is not None and lease.worker_id in wids \
+                        and not lease.dead:
+                    doomed.append(lease)
+        for lease in doomed:
+            self._on_lease_dead(lease)
+
+    def _on_spillback(self, lease: _Lease, rid, info):
+        """An oversubscribed executor bounced a push (reference: hybrid
+        policy spillback).  Re-queue the entry at the FRONT of its class
+        (rough submission order) and throttle the bouncing lease for the
+        saturation window; the next lease request is steered by the
+        next-best-node hint the HEAD attached to the grant (``info``
+        names only the bouncing executor's node — the executor has no
+        cluster view).  An entry that keeps bouncing reroutes to the
+        head — guaranteed progress."""
+        reroute = None
+        with self.lock:
+            entry = lease.inflight.pop(rid, None)
+            if entry is None:
+                return
+            if GLOBAL_CONFIG.decentralized_dispatch:
+                self.spillbacks += 1
+            lease.saturated_until = time.monotonic() + SATURATED_S
+            entry["spills"] = entry.get("spills", 0) + 1
+            pool = self._pool_locked(lease.klass)
+            bounced = (info or {}).get("node")
+            if bounced and pool.get("hint") == bounced:
+                # The stored next-best hint points at the node that just
+                # bounced us — stale; drop it rather than steer the next
+                # lease request back into the hot spot.
+                pool.pop("hint", None)
+            if entry["spills"] >= SPILL_MAX:
+                reroute = entry
+            else:
+                pool["queue"].appendleft(entry)
+            if not lease.inflight:
+                lease.idle_since = time.monotonic()
+        if reroute is not None:
+            self._reroute_to_head(reroute)
+        else:
+            self._pump(lease.klass)
 
     def _lease_reader(self, lease: _Lease):
         while not self._stopped:
@@ -827,6 +1006,8 @@ class DirectCaller:
                 self._on_result_batch(lease, [msg[1:]])
             elif msg[0] == "dresult_batch":
                 self._on_result_batch(lease, msg[1])
+            elif msg[0] == "dspill":
+                self._on_spillback(lease, msg[1], msg[2])
 
     def _on_result_batch(self, lease: _Lease, items):
         """Apply a burst of results under ONE lock pass (one notify, one
@@ -1007,44 +1188,64 @@ class DirectCaller:
             self._pump_any(klass)
 
     def _reroute_to_head(self, entry):
-        """No leases: delegate this spec (and its owned returns) to the
-        head scheduler so progress is guaranteed.  The entry's arg pins
-        are released only AFTER the head has the spec — the export in
-        submit_via_head must still see the args alive (a dropped-ref arg
-        would otherwise be freed before the head could pin it).
+        self._reroute_many([entry])
 
-        Dependents parked on this task's returns reroute too: no dresult
-        will ever arrive here to wake them, and the head resolves
-        delegated deps natively (their shells export with the specs)."""
-        spec = entry["spec"]
-        tid = TaskID(entry["tid_bin"])
+    def _reroute_many(self, entries):
+        """No leases: delegate these specs (and their owned returns) to
+        the head scheduler so progress is guaranteed.  A starved round
+        reroutes REROUTE_CHUNK specs — they ship as ONE
+        ("submit_batch", ...) message (one export pass, one pickle+write,
+        one head registration pass) instead of a single-submit storm,
+        which is exactly the multi-client fan-in path under contention.
+        The entries' arg pins are released only AFTER the head has the
+        specs — the export in submit_via_head must still see the args
+        alive (a dropped-ref arg would otherwise be freed before the
+        head could pin it).
+
+        Dependents parked on these tasks' returns reroute too: no
+        dresult will ever arrive here to wake them, and the head
+        resolves delegated deps natively (their shells export with the
+        specs)."""
+        done = []
         dependents = []
         actor_flips = []
         with self.lock:
-            if entry.get("rerouted"):
-                return
-            entry["rerouted"] = True
-            for i in range(spec["num_returns"]):
-                st = self.owned.get(tid.object_id(i))
-                if st is not None:
-                    st.status = DELEGATED
-                for dep_entry in self._dep_waiters.pop(
-                        tid.object_id(i).binary(), []) or []:
-                    dep_entry["deps"] -= 1
-                    if dep_entry.get("rerouted"):
-                        continue
-                    dspec = dep_entry["spec"]
-                    if "actor_id" in dspec:
-                        # Actor entries stay in their channel queue; the
-                        # channel must go head-mode (order-preserving
-                        # drain) since this dep resolves at the head.
-                        actor_flips.append(dspec["actor_id"])
-                        dep_entry["via_head"] = True
-                    else:
-                        dependents.append(dep_entry)
-        self.host.submit_via_head(spec)
+            for entry in entries:
+                if entry.get("rerouted"):
+                    continue
+                entry["rerouted"] = True
+                spec = entry["spec"]
+                tid = TaskID(entry["tid_bin"])
+                for i in range(spec["num_returns"]):
+                    st = self.owned.get(tid.object_id(i))
+                    if st is not None:
+                        st.status = DELEGATED
+                    for dep_entry in self._dep_waiters.pop(
+                            tid.object_id(i).binary(), []) or []:
+                        dep_entry["deps"] -= 1
+                        if dep_entry.get("rerouted"):
+                            continue
+                        dspec = dep_entry["spec"]
+                        if "actor_id" in dspec:
+                            # Actor entries stay in their channel queue;
+                            # the channel must go head-mode (order-
+                            # preserving drain) since this dep resolves
+                            # at the head.
+                            actor_flips.append(dspec["actor_id"])
+                            dep_entry["via_head"] = True
+                        else:
+                            dependents.append(dep_entry)
+                done.append(entry)
+        if not done and not actor_flips:
+            return
+        if len(done) > 1 and hasattr(self.host, "submit_via_head_many"):
+            self.host.submit_via_head_many([e["spec"] for e in done])
+        else:
+            for entry in done:
+                self.host.submit_via_head(entry["spec"])
         with self.lock:
-            self._unpin_entry_locked(entry)
+            for entry in done:
+                self._unpin_entry_locked(entry)
             for aid in actor_flips:
                 ch = self.actor_channels.get(aid)
                 if ch is not None and ch["state"] in ("direct",
@@ -1052,8 +1253,8 @@ class DirectCaller:
                     ch["state"] = "head_draining"
             self.cv.notify_all()
         self._flush_outbound()
-        for dep_entry in dependents:
-            self._reroute_to_head(dep_entry)
+        if dependents:
+            self._reroute_many(dependents)
         for aid in set(actor_flips):
             self._pump_actor(aid)
 
@@ -1069,10 +1270,16 @@ class DirectCaller:
                 self._linger_thread.start()
 
     def _linger_loop(self):
-        """Return idle leases to the head after LEASE_LINGER_S."""
+        """Return idle leases to the head after LEASE_LINGER_S; renew
+        BUSY leases' TTLs periodically (a long-running pushed task emits
+        no per-task renewals, and an unrenewed lease would be revoked
+        out from under it).  The deadline comes from each lease's
+        GRANTED ttl — the head's reaper expires against its own config,
+        which a config-skewed external client does not share."""
         while not self._stopped:
             time.sleep(LEASE_LINGER_S / 2)
             to_return: List[_Lease] = []
+            renew: List[str] = []
             now = time.monotonic()
             with self.lock:
                 any_leases = False
@@ -1087,7 +1294,17 @@ class DirectCaller:
                         else:
                             keep.append(lease)
                             any_leases = True
+                            if (lease.ttl > 0 and lease.inflight
+                                    and now - lease.last_renew
+                                    > lease.ttl / 3):
+                                lease.last_renew = now
+                                renew.append(lease.worker_id)
                     pool["leases"] = keep
+            if renew:
+                try:
+                    self.host.head_send(("lease_renew", renew))
+                except Exception:
+                    pass
             for lease in to_return:
                 lease.dead = True
                 try:
@@ -1314,7 +1531,10 @@ class DirectServer:
                  shm_unlink: Callable[[str, int, bool], None],
                  on_peer_msg: Optional[Callable] = None,
                  queue_empty: Optional[Callable[[], bool]] = None,
-                 on_task_queued: Optional[Callable[[dict], None]] = None):
+                 on_task_queued: Optional[Callable[[dict], None]] = None,
+                 queue_depth: Optional[Callable[[], int]] = None,
+                 spill_depth: int = 0,
+                 spill_info: Optional[dict] = None):
         from multiprocessing.connection import Listener
 
         host = os.environ.get("RAY_TPU_AGENT_LISTEN_HOST", "127.0.0.1")
@@ -1339,6 +1559,16 @@ class DirectServer:
         # while it computes (direct-path submissions carry the same
         # (size, store) SHM descriptors the head path does).
         self._on_task_queued = on_task_queued
+        # Spillback (reference: the raylet hybrid policy bouncing work
+        # off an oversubscribed node): a pushed task that opted in
+        # (``_spill_ok``, the capability gate) arriving while the local
+        # queue is at least spill_depth deep is answered with
+        # ("dspill", rid, spill_info) instead of queueing; the holder
+        # re-lands it on another lease or the hinted node.  spill_depth
+        # 0 disables.
+        self._queue_depth = queue_depth or (lambda: 0)
+        self._spill_depth = spill_depth
+        self._spill_info = spill_info or {}
         # Live reply channels: the worker's exec loop flushes buffered
         # replies on queue drain; the periodic flusher bounds latency.
         self._sources: set = set()
@@ -1391,19 +1621,33 @@ class DirectServer:
             else:
                 self._handle_direct_msg(msg, src)
 
+    def _should_spill(self, task: dict) -> bool:
+        # queue_depth is live: the enqueue callback appends synchronously,
+        # so tasks accepted earlier in this same batch already count.
+        return (self._spill_depth > 0
+                and task.get("_spill_ok")
+                and "actor_id" not in task
+                and self._queue_depth() >= self._spill_depth)
+
     def _handle_direct_msg(self, msg, src):
         tag = msg[0]
         if tag == "dexec":
             task = msg[2]
+            if self._should_spill(task):
+                src.spill(msg[1], self._spill_info)
+                return
             task["_dreply"] = (src, msg[1])
             src.note_enqueued(1)
             if self._on_task_queued is not None:
                 self._on_task_queued(task)
             self._enqueue(task, src)
         elif tag == "dexec_batch":
-            src.note_enqueued(len(msg[1]))
             for rid, task in msg[1]:
+                if self._should_spill(task):
+                    src.spill(rid, self._spill_info)
+                    continue
                 task["_dreply"] = (src, rid)
+                src.note_enqueued(1)
                 if self._on_task_queued is not None:
                     self._on_task_queued(task)
                 self._enqueue(task, src)
@@ -1456,6 +1700,16 @@ class _DirectSource:
     def note_enqueued(self, n: int):
         with self.send_lock:
             self._queued += n
+
+    def spill(self, rid, info):
+        """Bounce one push back to the holder immediately (spillback is
+        a flow-control signal — buffering it behind result batches would
+        defeat the point)."""
+        try:
+            with self.send_lock:
+                protocol.send(self.conn, ("dspill", rid, dict(info)))
+        except Exception:
+            pass  # caller went away; its death handling cleans up
 
     def reply(self, rid, ok, returns, meta):
         with self.send_lock:

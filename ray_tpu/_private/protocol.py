@@ -30,6 +30,27 @@ worker → driver
   ("decref_batch", [object_id_bytes])   buffered ref drops
   ("blocked", task_id_bytes) / ("unblocked", task_id_bytes)
   ("actor_exit", actor_id_bytes, ok, error_descr)
+lease plane (decentralized dispatch; all verbs are capability-gated:
+holders opt in via the ``lease_req`` opts dict / the ``_spill_ok`` task
+flag, so a peer that never advertises them is never sent one)
+  ("lease_req", rid, resources, n[, opts])   worker/client asks for leases;
+                                    opts {"v": 1, "hint": node_hex} selects
+                                    the dict-shaped reply {"grants":
+                                    [(wid, addr, node_hex)...], "slots",
+                                    "ttl", "hint"} (bare list without)
+  ("lease_grant", klass_items, grants, slots, ttl, hint)   head → holder:
+                                    unsolicited bulk grant piggybacked on a
+                                    head-brokered submit burst
+  ("lease_renew", [wid_hex])        holder liveness, one message per N
+                                    leased pushes (lease_renew_tasks)
+  ("lease_revoke", [wid_hex])       head → holder: leased worker gone
+                                    (node death / TTL expiry); rides the
+                                    conflation sender
+  ("dspill", rid, info)             executor → holder on the direct conn:
+                                    pushed task bounced (queue over
+                                    lease_spillback_depth); info names the
+                                    bouncing executor's node — the
+                                    next-best hint rides the lease grant
 either direction
   ("batch",  [msg, ...])            envelope: N back-to-back messages as
                                     ONE pickle + one write.  Receivers

@@ -175,7 +175,8 @@ class WorkerHandle:
         "ready", "dead", "outbox", "outbuf", "spawned_at",
         "lease_key", "lease_req", "lease_pg", "blocked",
         "pending_force_kill", "direct_addr", "client_lease",
-        "oom_killed", "last_dispatch_ts",
+        "oom_killed", "last_dispatch_ts", "lease_expiry",
+        "lease_offer_ts", "lease_caps",
     )
 
     def __init__(self, worker_id, conn, proc, node, env_key, tpu_chips):
@@ -217,6 +218,20 @@ class WorkerHandle:
         # last_dispatch_ts picks the NEWEST task's worker as the victim.
         self.oom_killed = False
         self.last_dispatch_ts = 0.0
+        # Decentralized dispatch: while client-leased, the holder must
+        # renew before this monotonic deadline or the reaper revokes the
+        # lease (None = no TTL: legacy holder or TTL disabled).  On a
+        # LESSEE handle, lease_offer_ts holds per-scheduling-class
+        # [last_offer_ts, eligible_specs_accumulated] pairs that
+        # rate-limit and threshold unsolicited bulk grants.
+        self.lease_expiry: Optional[float] = None
+        self.lease_offer_ts: Dict[tuple, list] = {}
+        # Capability gate for UNSOLICITED lease grants (PR-3 convention:
+        # never send a new verb to a peer that would silently drop it —
+        # here the drop would leak the acquired leases).  True for
+        # workers this head spawned (same build, env-matched); an
+        # external client earns it by sending a v1 lease_req.
+        self.lease_caps = False
 
     def send(self, msg):
         with self.send_lock:
@@ -450,6 +465,23 @@ class Runtime:
         self.deduped_pulls = 0
         self.prefetch_hit_bytes = 0
         self.prefetch_waste_bytes = 0
+        # Decentralized-dispatch counters (all zero when the
+        # decentralized_dispatch switch is off — pinned by tests):
+        # lease_grants     = worker leases handed to peer holders
+        #                    (solicited lease_req + unsolicited bulk
+        #                    grants piggybacked on submit bursts),
+        # lease_revocations= leases the head revoked (node/worker death,
+        #                    TTL expiry),
+        # head_brokered_submits = specs that reached the head's scheduler
+        #                    over the wire (the path leases exist to
+        #                    drain),
+        # leased_submits / spillbacks = holder-side counters aggregated
+        #                    from the periodic xfer_stats deltas.
+        self.lease_grants = 0
+        self.lease_revocations = 0
+        self.head_brokered_submits = 0
+        self.leased_submits = 0
+        self.spillbacks = 0
         # Identity of this process's object store: SHM descriptors carry it
         # so consumers know whether a segment is locally attachable or must
         # be shipped (reference: owner-based object directory).
@@ -559,6 +591,22 @@ class Runtime:
             target=self._task_sender_loop, daemon=True,
             name="ray_tpu-sender")
         self._sender.start()
+        # Sharded dispatch (decentralized_dispatch on): the hot submit
+        # and reply paths no longer run the global dispatch scan inside
+        # their own lock hold — they mark the affected scheduling
+        # class(es) dirty (per-shard dirty set, own LEAF lock: never
+        # taken around another lock; the event is set outside it) and
+        # the dispatcher thread drains dirty shards, each pass scoped to
+        # its class instead of scanning every queue.  With the switch
+        # off the shards are never marked and every site dispatches
+        # inline exactly as before.
+        self._dispatch_dirty: set = set()
+        self._dispatch_dirty_lock = threading.Lock()
+        self._dispatch_event = threading.Event()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="ray_tpu-dispatch")
+        self._dispatcher.start()
         # GCS-analog persistence: mutators bump _gcs_dirty; the snapshot
         # thread writes when it changed (reference: GCS tables persisted
         # to redis, redis_store_client.h:28).  Restore runs after the
@@ -589,6 +637,47 @@ class Runtime:
         with self._dirty_lock:
             self._dirty_workers.add(worker)
         self._sender_event.set()
+
+    # Sentinel marking "every shard needs a pass" (resources freed).
+    _DIRTY_ALL = object()
+
+    def _dispatch_loop(self):
+        """Drain dirty dispatch shards.  Runs the same per-class pass the
+        inline path runs, but OFF the submitting/replying thread: while
+        this thread scans one class under the runtime lock, the next
+        submit burst's registration only pays its table writes."""
+        while not self._stopped:
+            self._dispatch_event.wait()
+            self._dispatch_event.clear()
+            with self._dispatch_dirty_lock:
+                dirty, self._dispatch_dirty = self._dispatch_dirty, set()
+            if not dirty or self._stopped:
+                continue
+            keys = (None if self._DIRTY_ALL in dirty
+                    else [k for k in dirty])
+            try:
+                with self.lock:
+                    self._dispatch_locked(keys)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _request_dispatch_locked(self, keys=None):
+        """Dispatch trigger for the hot paths.  decentralized_dispatch
+        off: inline full pass, byte-identical to the pre-shard behavior.
+        On: mark the affected shard(s) dirty (``keys`` None = all — a
+        resource was freed, anything may now place) and let the
+        dispatcher thread run the scan outside this caller's lock
+        hold."""
+        if not self.config.decentralized_dispatch:
+            self._dispatch_locked()
+            return
+        with self._dispatch_dirty_lock:
+            if keys is None:
+                self._dispatch_dirty.add(self._DIRTY_ALL)
+            else:
+                self._dispatch_dirty.update(keys)
+        self._dispatch_event.set()
 
     def _queue_send(self, worker: "WorkerHandle", msg: tuple):
         """Buffer ``msg`` for the conflation sender.  Back-to-back sends
@@ -1333,8 +1422,14 @@ class Runtime:
                  "name": spec.get("name"),
                  "state": "SUBMITTED", "time": now})
         with self.lock:
-            dispatch = False
+            dispatch_keys: List[tuple] = []
             actor_ids: List[bytes] = []
+            if from_worker and self.config.decentralized_dispatch:
+                # The decentralization observable: specs that reached the
+                # head's scheduler over the wire.  Under a healthy lease
+                # plane this stays bounded by lease-renewal/starvation
+                # events, not task count (pinned by the acceptance test).
+                self.head_brokered_submits += len(specs)
             for rec, ev in zip(recs, events):
                 spec = rec.spec
                 tid = TaskID(spec["task_id"])
@@ -1375,11 +1470,22 @@ class Runtime:
                         actor_ids.append(aid)
                 elif rec.deps_pending == 0:
                     self._enqueue_pending_locked(rec)
-                    dispatch = True
+                    dispatch_keys.append(rec.sched_key)
             for aid in dict.fromkeys(actor_ids):
                 self._pump_actor_locked(self.actors[aid])
-            if dispatch:
-                self._dispatch_locked()
+            if dispatch_keys:
+                keys = list(dict.fromkeys(dispatch_keys))
+                if not self.config.decentralized_dispatch:
+                    self._dispatch_locked()
+                elif not from_worker and len(specs) == 1:
+                    # Driver sync-submit fast path: one spec, dispatch its
+                    # class inline (no thread hop on the latency path; the
+                    # scan is already scoped to one shard).
+                    self._dispatch_locked(keys)
+                else:
+                    # Burst: hand the scan to the dispatcher thread so
+                    # this submitter's lock hold ends at registration.
+                    self._request_dispatch_locked(keys)
 
     def _resolve_deps_locked(self, rec: TaskRecord):
         spec = rec.spec
@@ -1411,7 +1517,7 @@ class Runtime:
                     self._pump_actor_locked(self.actors[rec.actor_id])
                 else:
                     self._enqueue_pending_locked(rec)
-                    self._dispatch_locked()
+                    self._request_dispatch_locked([rec.sched_key])
 
     # -------------------------------------------------------- scheduling --
     # Sentinel for _pick_node_locked's pref parameter: "not computed yet"
@@ -1603,19 +1709,30 @@ class Runtime:
             rec.sched_key = self._sched_class(rec)
         self.pending_tasks.setdefault(rec.sched_key, deque()).append(rec)
 
-    def _dispatch_locked(self):
+    def _dispatch_locked(self, keys=None):
         """Assign queued tasks to workers.  Two-step per scheduling class,
         mirroring the reference's lease model (direct_task_transport.h:75):
         first pipeline onto already-leased workers of the class (up to
         max_tasks_in_flight each — the lease holds the resources, so
         pipelined tasks cost no extra slots), then lease new workers while
-        resources remain."""
+        resources remain.
+
+        ``keys`` scopes the pass to those scheduling classes (sharded
+        dispatch: a submit only needs its own class scanned — nothing it
+        did could unblock another class); None scans every class
+        (resource-release events, where anything may now place)."""
         if self._stopped:
             return
         if self.pending_pgs:
             self._try_reserve_pgs_locked()
-        for key in list(self.pending_tasks):
-            q = self.pending_tasks.get(key)
+        for key in (list(self.pending_tasks) if keys is None else keys):
+            self._dispatch_class_locked(key)
+        self._service_client_leases_locked()
+
+    def _dispatch_class_locked(self, key):
+        """One scheduling class's dispatch pass (the shard body)."""
+        q = self.pending_tasks.get(key)
+        if q is not None:
             while q:
                 rec = q[0]
                 if rec.cancelled or rec.dispatched:
@@ -1690,7 +1807,6 @@ class Runtime:
                 self._assign_to_worker_locked(worker, rec)
             if not q:
                 self.pending_tasks.pop(key, None)
-        self._service_client_leases_locked()
 
     def _count_locality_locked(self, pref, target: NodeState,
                                rec: TaskRecord):
@@ -1789,6 +1905,7 @@ class Runtime:
                     node.release(worker.lease_req)
         worker.lease_req = None
         worker.lease_pg = None
+        worker.lease_expiry = None
         worker.released = False
         worker.blocked = False
         had_tpu = bool(worker.tpu_chips)
@@ -1894,6 +2011,14 @@ class Runtime:
                 str(self.config.data_memory_budget_fraction),
             "RAY_TPU_DATA_MAX_INFLIGHT_TASKS":
                 str(self.config.data_max_inflight_tasks),
+            "RAY_TPU_DECENTRALIZED_DISPATCH":
+                "1" if self.config.decentralized_dispatch else "0",
+            "RAY_TPU_LEASE_SLOTS": str(self.config.lease_slots),
+            "RAY_TPU_LEASE_TTL_S": str(self.config.lease_ttl_s),
+            "RAY_TPU_LEASE_RENEW_TASKS":
+                str(self.config.lease_renew_tasks),
+            "RAY_TPU_LEASE_SPILLBACK_DEPTH":
+                str(self.config.lease_spillback_depth),
         }
 
     def _spawn_worker(self, node: NodeState, env_key: str,
@@ -2062,6 +2187,9 @@ class Runtime:
                     continue
                 if len(msg) > 3:
                     w.direct_addr = msg[3]
+                # Spawned by this head: same build, speaks the lease
+                # plane (unsolicited grants included).
+                w.lease_caps = True
                 w.attach(conn)
                 w.ready.set()
                 self._conn_to_worker[conn] = w
@@ -2095,13 +2223,14 @@ class Runtime:
             # The ack carries head config the agent must mirror (the
             # memory monitor's knobs — _system_config applies cluster-
             # wide, not just to the head's own sampler).
-            agent.send(("agent_ack", node.node_id.hex(), self.session_id,
-                        {"memory_monitor_threshold":
-                             self.config.memory_monitor_threshold,
-                         "memory_monitor_interval_s":
-                             self.config.memory_monitor_interval_s,
-                         "memory_monitor_test_file":
-                             self.config.memory_monitor_test_file}))
+            agent.send(  # noqa: RTL402 -- one-time handshake; the ack must beat any locked spawn_worker onto this conn
+                ("agent_ack", node.node_id.hex(), self.session_id,
+                 {"memory_monitor_threshold":
+                      self.config.memory_monitor_threshold,
+                  "memory_monitor_interval_s":
+                      self.config.memory_monitor_interval_s,
+                  "memory_monitor_test_file":
+                      self.config.memory_monitor_test_file}))
         threading.Thread(target=self._agent_reader, args=(conn, agent),
                          daemon=True, name="ray_tpu-rx-agent").start()
         with self.lock:
@@ -2115,12 +2244,20 @@ class Runtime:
     CLIENT_LEASE_PARK_S = 1.0
 
     def _grant_client_leases(self, lessee: WorkerHandle, rid,
-                             resources: Dict[str, float], n: int):
+                             resources: Dict[str, float], n: int,
+                             opts: Optional[dict] = None):
         """Lease up to ``n`` workers to a peer caller for direct task
         push.  The head acquires node resources (exactly like a dispatch
         lease) but never sees the tasks; the caller returns the lease via
         ("lease_return", ...) or by dying (reference: raylet
         RequestWorkerLease / ReturnWorker).
+
+        ``opts`` (lease-plane capability gate): {"v": 1} selects the
+        dict-shaped reply carrying per-worker node ids, the granted slot
+        count, the TTL the holder must renew within, and a next-best-node
+        hint; {"hint": node_hex} steers the grant toward that node (the
+        spillback hint round-tripping back, reference hybrid policy).
+        Absent/None keeps the legacy bare-list reply.
 
         Zero-grant requests are PARKED, not refused: the request waits
         (bounded) for resources to free, exactly like the raylet's lease
@@ -2129,9 +2266,11 @@ class Runtime:
         which is what collapsed multi-client task throughput."""
         req = {k: float(v) for k, v in resources.items()}
         with self.lock:
-            granted = self._try_client_grant_locked(lessee, req, n)
+            granted = self._try_client_grant_locked(
+                lessee, req, n, hint=(opts or {}).get("hint"))
             if not granted:
                 park = {"lessee": lessee, "rid": rid, "req": req, "n": n,
+                        "opts": opts,
                         "deadline": time.monotonic()
                         + self.CLIENT_LEASE_PARK_S}
                 self._pending_client_leases.append(park)
@@ -2140,17 +2279,33 @@ class Runtime:
                 t.daemon = True
                 t.start()
                 return
-        self._finish_client_grant(lessee, rid, granted)
+        self._finish_client_grant(lessee, rid, granted, opts=opts)
+
+    def _node_by_hex_locked(self, node_hex) -> Optional[NodeState]:
+        if not node_hex:
+            return None
+        for nid in self.node_order:
+            if nid.hex() == node_hex:
+                return self.nodes[nid]
+        return None
 
     def _try_client_grant_locked(self, lessee: WorkerHandle,
-                                 req: Dict[str, float],
-                                 n: int) -> List[WorkerHandle]:
+                                 req: Dict[str, float], n: int,
+                                 hint=None) -> List[WorkerHandle]:
+        hint_node = self._node_by_hex_locked(hint)
         granted: List[WorkerHandle] = []
         for _ in range(max(1, n)):
             pseudo = TaskRecord(
                 {"resources": req, "num_returns": 0,
                  "name": "client_lease", "task_id": b""}, req, 0)
-            node = self._pick_node_locked(pseudo)
+            if hint_node is not None and hint_node.alive \
+                    and hint_node.can_fit(req):
+                # Spillback hint: the holder just bounced off an
+                # oversubscribed node — place the replacement lease where
+                # the head said the capacity was.
+                node = hint_node
+            else:
+                node = self._pick_node_locked(pseudo)
             if node is None:
                 # Client leases are transient: blocked workers (usually
                 # the requesting clients themselves, parked in ray.get)
@@ -2166,6 +2321,19 @@ class Runtime:
             granted.append(w)
         return granted
 
+    def _spill_hint_locked(self, req: Dict[str, float],
+                           granted: List[WorkerHandle]) -> Optional[str]:
+        """Next-best node for this class BESIDES the ones just granted on
+        — shipped with the grant so a holder bouncing off an
+        oversubscribed worker knows where to ask next (the reference
+        hybrid policy's spillback target)."""
+        used = {id(w.node) for w in granted}
+        for nid in self.node_order:
+            node = self.nodes[nid]
+            if node.alive and id(node) not in used and node.can_fit(req):
+                return node.node_id.hex()
+        return None
+
     def _service_client_leases_locked(self):
         """Try parked client lease requests against freed capacity; called
         from _dispatch_locked (which runs on every resource release).
@@ -2180,12 +2348,17 @@ class Runtime:
             p = self._pending_client_leases.popleft()
             if p["lessee"].dead:
                 continue
+            opts = p.get("opts")
             granted = self._try_client_grant_locked(
-                p["lessee"], p["req"], p["n"])
+                p["lessee"], p["req"], p["n"],
+                hint=(opts or {}).get("hint"))
             if granted:
-                self._finish_client_grant(p["lessee"], p["rid"], granted)
+                self._finish_client_grant(p["lessee"], p["rid"], granted,
+                                          opts=opts)
             elif now >= p["deadline"]:
-                self._queue_send(p["lessee"], ("reply", p["rid"], []))
+                empty = ({"grants": []} if opts and opts.get("v")
+                         else [])
+                self._queue_send(p["lessee"], ("reply", p["rid"], empty))
             else:
                 still.append(p)
         self._pending_client_leases = still
@@ -2195,7 +2368,21 @@ class Runtime:
             self._service_client_leases_locked()
 
     def _finish_client_grant(self, lessee: WorkerHandle, rid,
-                             granted: List[WorkerHandle]):
+                             granted: List[WorkerHandle],
+                             opts: Optional[dict] = None,
+                             klass_items=None):
+        """Wait for the granted workers' handshakes off-thread, then ship
+        the grant.  Three reply shapes: the legacy bare list (no opts),
+        the v1 dict (opts["v"]), and — when ``rid`` is None — an
+        unsolicited ("lease_grant", ...) push piggybacked on a
+        head-brokered submit burst (``klass_items`` names the holder-side
+        scheduling class it belongs to)."""
+        v1 = bool(opts and opts.get("v")) or rid is None
+        cfg = self.config
+        ttl = (cfg.lease_ttl_s
+               if v1 and cfg.decentralized_dispatch else 0.0)
+        slots = min(cfg.lease_slots, cfg.max_tasks_in_flight_per_worker)
+
         def finish():
             # One shared deadline across the batch (not 15s each): a
             # stuck spawn must not serialize into minutes of stall.
@@ -2205,20 +2392,130 @@ class Runtime:
                 left = max(0.0, deadline - time.monotonic())
                 if (w.ready.wait(timeout=left) and w.direct_addr
                         and not w.dead):
-                    out.append((w.worker_id.hex(), tuple(w.direct_addr)))
+                    out.append((w.worker_id.hex(), tuple(w.direct_addr),
+                                w.node.node_id.hex()))
                 else:
                     failed.append(w)
-            if failed:
-                with self.lock:
-                    for w in failed:
-                        w.client_lease = None
-                        if not w.dead:
-                            self._end_lease_locked(w)
+            hint = None
+            with self.lock:
+                for w in failed:
+                    w.client_lease = None
+                    if not w.dead:
+                        self._end_lease_locked(w)
+                if failed:
                     self._dispatch_locked()
-            worker_send_safe(lessee, ("reply", rid, out))
+                ok = [w for w in granted if w not in failed]
+                if cfg.decentralized_dispatch:
+                    self.lease_grants += len(ok)
+                    if ttl > 0:
+                        expiry = time.monotonic() + ttl
+                        for w in ok:
+                            w.lease_expiry = expiry
+                if v1 and ok:
+                    hint = self._spill_hint_locked(ok[0].lease_req or {},
+                                                   ok)
+            if rid is None:
+                worker_send_safe(lessee, ("lease_grant", klass_items, out,
+                                          slots, ttl, hint))
+            elif v1:
+                worker_send_safe(lessee, ("reply", rid,
+                                          {"grants": out, "slots": slots,
+                                           "ttl": ttl, "hint": hint}))
+            else:
+                worker_send_safe(
+                    lessee, ("reply", rid, [g[:2] for g in out]))
 
         threading.Thread(target=finish, daemon=True,
                          name="ray_tpu-lease-grant").start()
+
+    # Unsolicited bulk grants: minimum direct-eligible specs in one
+    # head-brokered burst before the head piggybacks a lease grant on it,
+    # and the per-(lessee, class) re-offer interval.
+    LEASE_OFFER_MIN = 4
+    LEASE_OFFER_INTERVAL_S = 0.25
+
+    def _maybe_offer_lease(self, worker: WorkerHandle, specs: List[dict]):
+        """A worker/client just pushed a submit burst through the head.
+        If the burst is full of direct-eligible work, that means its
+        holder is short on leases (starvation reroute or first contact):
+        grant it a bulk lease on matching execution slots NOW, piggybacked
+        on this very exchange, so the NEXT burst rides the direct plane
+        instead of the head (reference: the raylet granting leases from
+        the queue that the spillback landed in).
+
+        Capability-gated: offered only to peers known to handle the
+        ("lease_grant", ...) verb — a peer that silently dropped it
+        would leak the acquired leases (PR-3 convention: new verbs are
+        never sent to a peer that would ignore them)."""
+        if not self.config.decentralized_dispatch or not worker.lease_caps:
+            return
+        elig = [s for s in specs
+                if "actor_id" not in s
+                and not s.get("scheduling_strategy")
+                and not s.get("runtime_env")
+                # Ref-carrying specs reached the head because their refs
+                # are HEAD-owned — the holder's eligible() will keep
+                # routing them here regardless of leases, so granting on
+                # their account would be pure worker churn.
+                and not any(a and a[0] == "ref"
+                            for a in s.get("args", ()))
+                and not any(v and v[0] == "ref"
+                            for v in (s.get("kwargs") or {}).values())
+                and all(k == "CPU"
+                        for k in (s.get("resources") or {"CPU": 1.0}))]
+        if not elig:
+            return
+        # Per-class accumulation: a mixed burst must not credit the
+        # first spec's class with the whole count (oversized grants for
+        # one class, starvation for the rest).
+        by_klass: Dict[tuple, int] = {}
+        for s in elig:
+            req = {k: float(v) for k, v in (s.get("resources")
+                                            or {"CPU": 1.0}).items()}
+            key = tuple(sorted(req.items()))
+            by_klass[key] = by_klass.get(key, 0) + 1
+        now = time.monotonic()
+        slots = max(1, min(self.config.lease_slots,
+                           self.config.max_tasks_in_flight_per_worker))
+        offers = []
+        with self.lock:
+            for klass_items, count in by_klass.items():
+                ent = worker.lease_offer_ts.get(klass_items)
+                if ent is None:
+                    ent = worker.lease_offer_ts[klass_items] = [0.0, 0]
+                # Accumulate across bursts: a starved holder reroutes
+                # specs as SINGLE ("submit", ...) messages, so the offer
+                # threshold must trigger on their sum, not any one
+                # message's size.  These O(1) checks run FIRST — the
+                # cluster scans below are paid at most once per offer
+                # interval per class, never per submit message on the
+                # contended fan-in path.
+                ent[1] += count
+                if ent[1] < self.LEASE_OFFER_MIN \
+                        or now - ent[0] < self.LEASE_OFFER_INTERVAL_S:
+                    continue
+                # Redundant-grant guard: a holder with a PARKED
+                # lease_req is already first in line for freed capacity,
+                # and one that still holds leases is not starved — an
+                # unsolicited grant on top would just churn extra worker
+                # processes.  Reset the accumulator: this burst is
+                # already being served.
+                if any(p["lessee"] is worker
+                       for p in self._pending_client_leases) \
+                        or any(w.client_lease is worker and not w.dead
+                               for node in self.nodes.values()
+                               for w in node.all_workers.values()):
+                    ent[0], ent[1] = now, 0
+                    continue
+                n = min(8, max(1, ent[1] // slots))
+                ent[0], ent[1] = now, 0
+                granted = self._try_client_grant_locked(
+                    worker, dict(klass_items), n)
+                if granted:
+                    offers.append((klass_items, granted))
+        for klass_items, granted in offers:
+            self._finish_client_grant(worker, None, granted,
+                                      klass_items=klass_items)
 
     def _send_task(self, worker: WorkerHandle, rec: TaskRecord):
         spec = rec.spec
@@ -2921,6 +3218,8 @@ class Runtime:
                 self.prefetch_hit_bytes += d.get("prefetch_hit_bytes", 0)
                 self.prefetch_waste_bytes += d.get(
                     "prefetch_waste_bytes", 0)
+                self.leased_submits += d.get("leased_submits", 0)
+                self.spillbacks += d.get("spillbacks", 0)
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
         elif tag == "result_batch":
@@ -3045,10 +3344,15 @@ class Runtime:
             # refs locally; per-connection FIFO guarantees any later use
             # of them arrives after this spec.
             self.submit_task_from_worker(msg[2], submitter=worker)
+            self._maybe_offer_lease(worker, [msg[2]])
         elif tag == "submit_batch":
             # Bulk fire-and-forget submission (worker/client fan-out):
-            # one lock pass + one dispatch for the whole list.
+            # one lock pass + one dispatch for the whole list.  A burst
+            # of direct-eligible specs arriving HERE means the holder is
+            # lease-starved: piggyback a bulk lease grant on the exchange
+            # so the next burst rides the direct plane.
             self.submit_tasks_from_worker(msg[1], submitter=worker)
+            self._maybe_offer_lease(worker, msg[1])
         elif tag == "create_actor_req":
             _, rid, spec, creation_opts = msg
             try:
@@ -3230,7 +3534,26 @@ class Runtime:
             # A caller wants executor workers to push tasks to directly;
             # the head only does the resource accounting (reference: the
             # raylet's RequestWorkerLease, direct_task_transport.cc:568).
-            self._grant_client_leases(worker, msg[1], msg[2], msg[3])
+            opts = msg[4] if len(msg) > 4 else None
+            if opts and opts.get("v"):
+                # The peer just proved it speaks the v1 lease plane:
+                # unsolicited grants may now be pushed to it too.
+                worker.lease_caps = True
+            self._grant_client_leases(worker, msg[1], msg[2], msg[3],
+                                      opts)
+        elif tag == "lease_renew":
+            # Holder liveness, one message per N leased pushes: bump the
+            # named leases' TTL deadlines (pushed tasks never touch the
+            # head, so this is the only signal the holder is still
+            # driving them).
+            if self.config.lease_ttl_s > 0:
+                expiry = time.monotonic() + self.config.lease_ttl_s
+                with self.lock:
+                    for wid in msg[1]:
+                        w = self._workers_by_hex.get(wid)
+                        if w is not None and w.client_lease is worker \
+                                and not w.dead:
+                            w.lease_expiry = expiry
         elif tag == "lease_return":
             with self.lock:
                 for wid in msg[1]:
@@ -3239,7 +3562,7 @@ class Runtime:
                             and not w.dead:
                         w.client_lease = None
                         self._end_lease_locked(w)
-                self._dispatch_locked()
+                self._request_dispatch_locked()
         elif tag == "export_obj":
             # A worker delegates ownership of objects it created to the
             # head (they are about to be consumed through head-routed
@@ -3333,14 +3656,14 @@ class Runtime:
                         and worker.lease_pg is None):
                     worker.node.release(worker.lease_req)
                     worker.released = True
-                self._dispatch_locked()
+                self._request_dispatch_locked()
         elif tag == "unblocked":
             with self.lock:
                 worker.blocked = False
                 if worker.lease_req is not None and worker.released:
                     worker.node.acquire(worker.lease_req)
                     worker.released = False
-                self._dispatch_locked()
+                self._request_dispatch_locked()
         elif tag == "stolen":
             # Tasks the worker relinquished (never started): re-dispatch
             # elsewhere.  Their results can no longer arrive from it.
@@ -3369,7 +3692,7 @@ class Runtime:
                 if not worker.inflight and worker.lease_req is not None \
                         and not worker.dead and worker.actor_id is None:
                     self._end_lease_locked(worker)
-                self._dispatch_locked()
+                self._request_dispatch_locked()
         elif tag == "actor_exit":
             pass
 
@@ -3496,7 +3819,7 @@ class Runtime:
                 # failure path handled via _fail_task? create failure comes
                 # back as result with ok=False:
                 else:
-                    err = serialization.loads_inline(returns[0][1])
+                    err = serialization.loads_inline(returns[0][1])  # noqa: RTL402 -- cold actor-creation-failure path; inline error payloads are small
                     actor.status = DEAD
                     actor.death_cause = err
                     if not actor.created_future.done():
@@ -3511,12 +3834,23 @@ class Runtime:
                     self._pump_actor_locked(actor)
                 return
             worker.inflight.pop(task_id_bin, None)
-            # Top up this worker's pipeline (and everyone else's) before
-            # deciding the lease is over.
-            self._dispatch_locked()
-            if not worker.inflight and not worker.dead \
-                    and worker.lease_req is not None:
-                self._end_lease_locked(worker)
+            # Top up this worker's pipeline before deciding the lease is
+            # over.  Sharded: only this worker's own class can have
+            # gained a slot — scan just that shard inline; the global
+            # pass runs (deferred) only when the lease actually ends and
+            # returns resources anything could use.
+            if self.config.decentralized_dispatch:
+                if worker.lease_key is not None:
+                    self._dispatch_class_locked(worker.lease_key)
+                if not worker.inflight and not worker.dead \
+                        and worker.lease_req is not None:
+                    self._end_lease_locked(worker)
+                    self._request_dispatch_locked()
+            else:
+                self._dispatch_locked()
+                if not worker.inflight and not worker.dead \
+                        and worker.lease_req is not None:
+                    self._end_lease_locked(worker)
 
     def _reroute_dead_worker_frees_locked(self, worker: WorkerHandle):
         """A dead worker's buffered free_segment messages would vanish
@@ -3597,6 +3931,19 @@ class Runtime:
                         w.client_lease = None
                         if not w.dead:
                             self._end_lease_locked(w)
+            if worker.client_lease is not None \
+                    and not worker.client_lease.dead \
+                    and self.config.decentralized_dispatch:
+                # This worker was leased OUT and died (node death rides
+                # the same path — the agent's death handler drives it):
+                # revoke explicitly so the holder reroutes its pushed
+                # specs now instead of waiting on a direct-conn EOF.
+                # Rides the conflation sender like every control-plane
+                # notification.
+                self.lease_revocations += 1
+                self._queue_send(worker.client_lease,
+                                 ("lease_revoke",
+                                  [worker.worker_id.hex()]))
             worker.client_lease = None
             # Pending-export shells this worker owed a completion for:
             # the owner is gone, fail them (owner-death semantics).
@@ -3604,7 +3951,7 @@ class Runtime:
             for oid, st in list(self.objects.items()):
                 if st.exporter is worker and st.status == PENDING:
                     if err is None:
-                        err = (protocol.ERROR, serialization.dumps_inline(
+                        err = (protocol.ERROR, serialization.dumps_inline(  # noqa: RTL402 -- cold worker-death path; constant-sized error payload
                             exc.ObjectLostError(
                                 "Owner worker died before completing "
                                 "its exported object")))
@@ -3799,6 +4146,30 @@ class Runtime:
             now = time.monotonic()
             dead_pending = []
             with self.lock:
+                if self.config.decentralized_dispatch \
+                        and self.config.lease_ttl_s > 0:
+                    # Expired client leases: the holder stopped renewing
+                    # (died or hung mid-push).  Pushed-task state is
+                    # invisible to the head, so the worker is RETIRED,
+                    # not pooled — holder-side retries cover its queue,
+                    # the same semantics as worker death.
+                    expired = [
+                        w for node in self.nodes.values()
+                        for w in node.all_workers.values()
+                        if w.client_lease is not None and not w.dead
+                        and w.lease_expiry is not None
+                        and now > w.lease_expiry]
+                    for w in expired:
+                        lessee = w.client_lease
+                        w.client_lease = None
+                        self.lease_revocations += 1
+                        if lessee is not None and not lessee.dead:
+                            self._queue_send(
+                                lessee, ("lease_revoke",
+                                         [w.worker_id.hex()]))
+                        self._end_lease_locked(w, reap=True)
+                    if expired:
+                        self._request_dispatch_locked()
                 for node in self.nodes.values():
                     for key, lst in node.idle_workers.items():
                         keep = []
@@ -3948,6 +4319,7 @@ class Runtime:
             return
         self._stopped = True
         self._sender_event.set()  # unblock the conflation sender's exit
+        self._dispatch_event.set()  # unblock the dispatcher's exit
         with self.lock:
             workers = [w for n in self.nodes.values()
                        for w in list(n.all_workers.values())]
@@ -4200,6 +4572,11 @@ class Runtime:
                 "deduped_pulls": self.deduped_pulls,
                 "brokered_parts": self.brokered_parts,
                 "relayed_segments": self.relayed_segments,
+                "lease_grants": self.lease_grants,
+                "leased_submits": self.leased_submits,
+                "spillbacks": self.spillbacks,
+                "lease_revocations": self.lease_revocations,
+                "head_brokered_submits": self.head_brokered_submits,
             }
 
     def list_nodes(self):

@@ -185,6 +185,14 @@ class _WorkerRuntime:
         self._export_for_head_path(spec)
         self._send(("submit", 0, spec))
 
+    def submit_via_head_many(self, specs: list):
+        """Bulk reroute: a starved lease round's REROUTE_CHUNK specs ship
+        as ONE ("submit_batch", ...) message (exports first, same-conn
+        FIFO) instead of a single-submit storm on the head."""
+        for spec in specs:
+            self._export_for_head_path(spec)
+        self._send(("submit_batch", specs))
+
     @property
     def current_task_id(self) -> Optional[TaskID]:
         return getattr(self._tls, "task_id", None)
@@ -280,6 +288,10 @@ class _WorkerRuntime:
         two concurrent flushers must never report the same delta twice."""
         with self._xfer_lock:
             cur = self._pull_registry.stats()
+            # Lease-plane counters ride the same delta stream (the head
+            # aggregates leased_submits/spillbacks next to its own
+            # lease_grants/head_brokered_submits).
+            cur.update(self.direct.stats())
             delta = {k: v - self._xfer_sent.get(k, 0)
                      for k, v in cur.items()}
             if not any(delta.values()):
@@ -1258,6 +1270,16 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                 handle(m)
         elif tag == "steal":
             steal(msg[1], set(msg[2]))
+        elif tag == "lease_grant":
+            # Unsolicited bulk lease grant piggybacked on a head-brokered
+            # submit burst: adopt off-thread (adoption dials the granted
+            # workers; the reader must keep draining).
+            threading.Thread(
+                target=rt.direct.adopt_grant,
+                args=(msg[1], msg[2], msg[3], msg[4], msg[5]),
+                daemon=True, name="ray_tpu-lease-adopt").start()
+        elif tag == "lease_revoke":
+            rt.direct.revoke(msg[1])
         elif tag == "func":
             fns.put(msg[1], msg[2])
         elif tag == "obj":
@@ -1310,11 +1332,17 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
         if busy:
             rt.prefetcher.offer(task)
 
+    from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
     direct_server = direct_mod.DirectServer(
         bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")),
         direct_enqueue, fns.put, rt.shm.unlink,
         on_peer_msg=rt.dispatch_peer_msg, queue_empty=_queue_empty,
-        on_task_queued=maybe_prefetch)
+        on_task_queued=maybe_prefetch,
+        queue_depth=lambda: len(tasks),
+        spill_depth=(_cfg.lease_spillback_depth
+                     if _cfg.decentralized_dispatch else 0),
+        spill_info={"node": node_id_hex})
     rt.direct_addr = direct_server.address
 
     def decref_flusher():

@@ -59,6 +59,19 @@ RTL401  lock-acquire-no-with
     deadlocks the next acquirer.  Use ``with lock:``; non-blocking /
     timeout try-locks (``acquire(False)``, ``acquire(timeout=...)``) are
     exempt because ``with`` cannot express them.
+
+RTL402  blocking-io-under-runtime-lock
+    A blocking socket operation (``protocol.send/recv``,
+    ``*.send_bytes/recv_bytes``, ``conn/agent/worker.send/recv``) or a
+    payload (un)pickle (``pickle.dumps/loads``,
+    ``serialization.dumps*/loads*``) lexically inside a ``with
+    self.lock:`` / ``with self._lock:`` body.  Table locks serialize the
+    whole runtime: one slow peer's TCP buffer or one multi-MB pickle
+    under the lock stalls EVERY submit/result/free on the head — exactly
+    the contention class the decentralized-dispatch refactor removes.
+    Buffer through the conflation sender (``_queue_send``) or move the
+    work outside the critical section.  Lexical heuristic only: calls
+    reached from a locked section through another function are not seen.
 """
 
 from __future__ import annotations
@@ -79,7 +92,17 @@ RULES: Dict[str, str] = {
     "RTL301": "bare 'except:' swallows SystemExit/KeyboardInterrupt",
     "RTL401": "lock .acquire() outside 'with' leaks the lock on error "
               "paths",
+    "RTL402": "blocking socket send/recv or payload (un)pickling while "
+              "holding a runtime lock stalls every other acquirer",
 }
+
+# RTL402: the runtime/table locks the rule guards (deliberately NOT
+# send_lock/buf_lock — those exist to guard a socket write and holding
+# them across the send is the design).
+_RUNTIME_LOCK_RE = re.compile(r"^_?lock$")
+# Receivers whose .send()/.recv() is a blocking socket call in this
+# codebase (connection objects and the head-side peer handles).
+_SOCKISH_RE = re.compile(r"conn|sock|agent|worker|lessee|peer|client")
 
 _NOQA_RE = re.compile(r"#\s*noqa:\s*([A-Z0-9, ]+)", re.IGNORECASE)
 
@@ -177,6 +200,10 @@ class _Linter(ast.NodeVisitor):
             self._index_blocks(table)
         self.time_aliases: Set[str] = {"time"}
         self.sleep_aliases: Set[str] = set()
+        # RTL402: lexical nesting depth inside `with <runtime lock>:`
+        # bodies (reset inside nested function defs — their bodies run at
+        # call time, not under this acquisition).
+        self._lock_depth = 0
         self._collect_imports(tree)
 
     # -- setup -------------------------------------------------------------
@@ -232,11 +259,13 @@ class _Linter(ast.NodeVisitor):
     def _visit_function(self, node, kind: str):
         self._check_remote_capture(node)
         self.frames.append(_Frame(kind, node.name))
+        saved_depth, self._lock_depth = self._lock_depth, 0
         try:
             for stmt in node.body:
                 self.visit(stmt)
         finally:
             self.frames.pop()
+            self._lock_depth = saved_depth
 
     def visit_FunctionDef(self, node: ast.FunctionDef):
         for dec in node.decorator_list:
@@ -250,10 +279,14 @@ class _Linter(ast.NodeVisitor):
 
     def visit_Lambda(self, node: ast.Lambda):
         self.frames.append(_Frame("lambda", "<lambda>"))
+        # Like nested defs, a lambda's body runs at CALL time, not under
+        # the enclosing with-lock acquisition (RTL402).
+        saved_depth, self._lock_depth = self._lock_depth, 0
         try:
             self.visit(node.body)
         finally:
             self.frames.pop()
+            self._lock_depth = saved_depth
 
     def visit_ClassDef(self, node: ast.ClassDef):
         self.frames.append(_Frame("class", node.name))
@@ -305,10 +338,60 @@ class _Linter(ast.NodeVisitor):
                     "Exception instead")
         self.generic_visit(node)
 
+    def _holds_runtime_lock(self, node) -> bool:
+        for item in node.items:
+            chain = _attr_chain(item.context_expr)
+            if chain and _RUNTIME_LOCK_RE.match(chain[-1]):
+                return True
+        return False
+
+    def visit_With(self, node: ast.With):
+        held = self._holds_runtime_lock(node)
+        if held:
+            self._lock_depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            if held:
+                self._lock_depth -= 1
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self.visit_With(node)
+
     def visit_Call(self, node: ast.Call):
         self._check_async_blocking(node)
         self._check_lock_acquire(node)
+        self._check_lock_io(node)
         self.generic_visit(node)
+
+    def _check_lock_io(self, node: ast.Call):
+        """RTL402 — blocking socket IO / payload pickling while a runtime
+        lock is (lexically) held."""
+        if self._lock_depth <= 0:
+            return
+        chain = _attr_chain(node.func)
+        if not chain or len(chain) < 2:
+            return
+        leaf, owner = chain[-1], chain[-2]
+        what = None
+        if owner == "protocol" and leaf in ("send", "recv", "send_batch"):
+            what = f"protocol.{leaf}()"
+        elif leaf in ("send_bytes", "recv_bytes"):
+            what = f"{owner}.{leaf}()"
+        elif leaf in ("send", "recv") and _SOCKISH_RE.search(owner.lower()):
+            what = f"{owner}.{leaf}()"
+        elif owner == "pickle" and leaf in ("dumps", "loads"):
+            what = f"pickle.{leaf}()"
+        elif owner == "serialization" and (leaf.startswith("dumps")
+                                           or leaf.startswith("loads")):
+            what = f"serialization.{leaf}()"
+        if what:
+            self._emit(
+                node, "RTL402",
+                f"blocking '{what}' inside a 'with <runtime lock>:' body "
+                "stalls every other lock acquirer — buffer via the "
+                "conflation sender or move it outside the critical "
+                "section")
 
     def _check_async_blocking(self, node: ast.Call):
         frame = self._nearest_function()

@@ -57,12 +57,11 @@ class PlacementGroup:
             # frees the object and the ref resolves never.
             st.local_refs += 1
 
+        descr = (protocol.INLINE, serialization.dumps_inline(True))
+
         def _complete(_f):
             with rt.lock:
-                rt._complete_object_locked(
-                    oid,
-                    (protocol.INLINE, serialization.dumps_inline(True)),
-                    ok=True)
+                rt._complete_object_locked(oid, descr, ok=True)
 
         fut.add_done_callback(_complete)
         return ObjectRef(oid, _register=False)

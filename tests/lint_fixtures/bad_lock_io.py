@@ -1,0 +1,45 @@
+"""RTL402 bad cases: blocking socket IO / payload pickling while a
+runtime (table) lock is held."""
+import pickle
+import threading
+
+from ray_tpu._private import protocol, serialization
+
+
+class Head:
+    def __init__(self, conn):
+        self.lock = threading.RLock()
+        self.conn = conn
+        self.table = {}
+
+    def reply_under_lock(self, rid, payload):
+        with self.lock:
+            self.table[rid] = payload
+            protocol.send(self.conn, ("reply", rid, payload))  # EXPECT: RTL402
+
+    def pickle_under_lock(self, value):
+        with self.lock:
+            return pickle.dumps(value)  # EXPECT: RTL402
+
+    def serialize_under_lock(self, value):
+        with self.lock:
+            return serialization.dumps_inline(value)  # EXPECT: RTL402
+
+
+class Owner:
+    def __init__(self, worker):
+        self._lock = threading.Lock()
+        self.worker = worker
+
+    def notify_under_private_lock(self, msg):
+        with self._lock:
+            self.worker.send(msg)  # EXPECT: RTL402
+
+    def raw_bytes_under_lock(self, conn, blob):
+        with self._lock:
+            conn.send_bytes(blob)  # EXPECT: RTL402
+
+    def unpickle_under_nested_lock(self, other, blob):
+        with self._lock:
+            with other.lock:
+                return serialization.loads_inline(blob)  # EXPECT: RTL402

@@ -1,0 +1,54 @@
+"""RTL402 good cases: the IO/pickling happens OUTSIDE the runtime-lock
+critical section (or under a send lock, whose whole purpose is guarding
+that one socket write), and nested defs under a lock don't count — their
+bodies run at call time."""
+import pickle
+import threading
+
+from ray_tpu._private import protocol, serialization
+
+
+class Head:
+    def __init__(self, conn):
+        self.lock = threading.RLock()
+        self.send_lock = threading.Lock()
+        self.conn = conn
+        self.table = {}
+
+    def reply_outside_lock(self, rid, payload):
+        with self.lock:
+            self.table[rid] = payload
+        protocol.send(self.conn, ("reply", rid, payload))
+
+    def pickle_then_store(self, rid, value):
+        blob = pickle.dumps(value)
+        with self.lock:
+            self.table[rid] = blob
+        return serialization.dumps_inline(rid)
+
+    def send_under_send_lock(self, msg):
+        # A send lock guards exactly this socket write: holding it across
+        # the send IS the design (it is not a table lock).
+        with self.send_lock:
+            protocol.send(self.conn, msg)
+
+    def buffer_under_lock(self, worker, msg):
+        with self.lock:
+            # Conflation-sender pattern: buffering is lock-cheap; the
+            # sender thread does the pickle + write outside.
+            worker.queue_msg(msg)
+
+    def nested_def_under_lock(self, conn, blob):
+        with self.lock:
+            def flush():
+                # Runs at CALL time, not under this acquisition.
+                protocol.send(conn, blob)
+
+            self.table["flush"] = flush
+        return self.table["flush"]
+
+    def lambda_under_lock(self, conn, blob):
+        with self.lock:
+            # Same as a nested def: the body runs at call time.
+            self.table["flush"] = lambda: protocol.send(conn, blob)
+        return self.table["flush"]

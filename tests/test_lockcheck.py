@@ -392,6 +392,40 @@ def test_shm_store_copy_pool_lock_convention(checker, monkeypatch,
     store.cleanup()
 
 
+def test_dispatch_shard_dirty_lock_convention(checker):
+    """Decentralized dispatch's documented convention: the per-shard
+    dirty-set lock (Runtime._dispatch_dirty_lock) is an independent LEAF
+    — marking a shard dirty happens under the runtime lock on the hot
+    paths, the dispatcher's wake event is set OUTSIDE it, and NO other
+    lock is ever acquired under it.  The recorded acquisition graph must
+    show zero outgoing edges from it across a real submit/result cycle
+    (driver bursts route through the deferred-dispatch marking)."""
+    import ray_tpu as ray
+    from ray_tpu._private import api_internal
+
+    ray.init(num_cpus=2, num_tpus=0)
+    try:
+        rt = api_internal.get_runtime()
+        assert isinstance(rt._dispatch_dirty_lock, lockcheck._LockProxy)
+        assert rt.config.decentralized_dispatch
+
+        @ray.remote
+        def f(x):
+            return x + 1
+
+        # Burst (deferred marking) + per-result class top-ups.
+        assert ray.get([f.remote(i) for i in range(8)]) == \
+            list(range(1, 9))
+        dirty_site = rt._dispatch_dirty_lock._site
+    finally:
+        ray.shutdown()
+    edges = checker.edges()
+    assert edges.get(dirty_site, set()) == set(), (
+        f"a lock was acquired while holding the dispatch dirty lock: "
+        f"{edges.get(dirty_site)}")
+    checker.assert_acyclic()
+
+
 # -- event-loop stall watch -------------------------------------------------
 
 def test_event_loop_stall_recorded(checker):

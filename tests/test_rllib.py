@@ -101,6 +101,9 @@ def test_impala_learns_cartpole(ray8):
     assert best >= 100.0, f"IMPALA failed to learn CartPole: best={best}"
 
 
+@pytest.mark.slow  # ~31s; duplicate coverage: tune.run wiring is tier-1
+                   # in test_tune.py and Algorithm.train() keeps its
+                   # tier-1 representative in the checkpoint test below
 def test_algorithm_is_tunable(ray8):
     """Reference: every Algorithm inherits Tune's Trainable — tune.run(PPO)
     works (rllib/algorithms/algorithm.py:146)."""

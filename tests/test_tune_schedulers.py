@@ -38,6 +38,10 @@ class Converging(Trainable):
         self.t = state["t"]
 
 
+@pytest.mark.slow  # ~38s; early-stopping schedulers keep their tier-1
+                   # representative in test_tune.py's ASHA rung-logic +
+                   # integration tests; hyperband's pause/promote
+                   # specifics stay covered in the slow tier
 def test_hyperband_promotes_best_and_stops_losers(cluster):
     targets = [0.1, 0.2, 0.9, 0.4, 0.95, 0.3]
     analysis = tune.run(
