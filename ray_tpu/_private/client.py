@@ -20,22 +20,50 @@ Usage::
 from __future__ import annotations
 
 import os
+import pickle
 import tempfile
 import threading
 from typing import Optional
 
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import object_transfer, protocol, serialization
 from ray_tpu._private import object_ref as object_ref_mod
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.shm_store import ShmStore
 from ray_tpu._private.worker_main import _WorkerRuntime
 
+# Small-put coalescing bounds: buffered inline puts flush as ONE
+# ("batch", ...) pickle+write once this many accumulate (or this many
+# payload bytes), before any other outgoing message, and at worst on the
+# 0.25s periodic flusher.
+_PUT_FLUSH_COUNT = 16
+_PUT_FLUSH_BYTES = 4 << 20
+
+# Direct-put floor: below this, the legacy fire-and-forget put_parts
+# message (one local pickle+write, no reply awaited) beats the direct
+# path's three blocking round trips (reserve ack, range ack, commit ack)
+# on any link with real latency; above it, transfer time dominates and
+# the zero-copy data plane wins.
+_DIRECT_PUT_MIN = 4 << 20
+
 
 class ClientRuntime(_WorkerRuntime):
     """Worker runtime minus execution: submits, gets, puts, actors."""
 
     is_client = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # (store_id, object_addr, caps) of the head's object server,
+        # from the client_ack info dict — None against an old head (no
+        # info element) keeps every put on the legacy path.
+        self._head_put_info = None
+        # Buffered small ("put", ...)/("addref", ...) message pairs:
+        # many tiny puts ride out as one pickle+write instead of one
+        # each (PR 2's conflation envelope, applied to the put path).
+        self._put_buf: list = []
+        self._put_buf_bytes = 0
+        self._put_lock = threading.Lock()
 
     def put_object(self, value) -> ObjectRef:
         oid = ObjectID.for_put()
@@ -45,19 +73,90 @@ class ClientRuntime(_WorkerRuntime):
         finally:
             nested = self.end_ref_collection()
         if res[0] == "inline":
-            self._send(("put", oid.binary(),
-                        (protocol.INLINE, res[1]), nested))
+            # Coalesced: the ref's addref rides the same buffer (in
+            # order), so _register=False below — the head still counts
+            # exactly one ref for this client.
+            self._queue_small_put(
+                ("put", oid.binary(), (protocol.INLINE, res[1]), nested),
+                oid, len(res[1]))
+            self._cache_put(oid, value)
+            return ObjectRef(oid, _register=False)
+        descr = (self._direct_put(oid, res[1], res[2])
+                 if res[3] >= _DIRECT_PUT_MIN else None)
+        if descr is not None:
+            # Payload already landed in the head's store over the data
+            # plane; the control connection carries only this O(1)
+            # commit.
+            self._send(("put_commit", oid.binary(), descr, nested))
         else:
-            # Ship parts: the head writes them into ITS store so cluster
-            # workers can consume them (clients share no /dev/shm).
+            # Legacy path: ship parts for the head to assemble into ITS
+            # store (clients share no /dev/shm).  PickleBuffer wrapping
+            # sends the buffer views — pickle already copies once into
+            # the message stream; the old [bytes(b) ...] copied twice.
             self._send(("put_parts", oid.binary(), res[1],
-                        [bytes(b) for b in res[2]], nested))
+                        [pickle.PickleBuffer(b) for b in res[2]], nested))
         self._cache_put(oid, value)
         return ObjectRef(oid)
 
+    def _direct_put(self, oid: ObjectID, meta, views):
+        """Push a large value straight into the head's store over the
+        object-transfer data plane; returns the committed descriptor, or
+        None (caller falls back to legacy put_parts) when the head never
+        advertised the put verbs, the master switch is off, or the push
+        failed."""
+        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+        info = self._head_put_info
+        if info is None or not _cfg.direct_puts:
+            return None
+        store_id, addr, caps = info
+        if not object_transfer.peer_accepts_puts(caps):
+            return None
+        try:
+            kind, ident, size = self._pusher.push(
+                store_id, addr, oid.binary(), meta, views, caps=caps)
+        except Exception:
+            return None
+        if kind == "spilled":
+            # Admission degraded the reservation to the head node's
+            # spill path rather than overcommitting tmpfs.
+            return (protocol.SPILLED, ident, size, store_id)
+        return (protocol.SHM, ident, size, store_id)
+
+    def _queue_small_put(self, msg, oid: ObjectID, nbytes: int):
+        with self._put_lock:
+            self._put_buf.append(msg)
+            self._put_buf.append(("addref", oid.binary()))
+            self._put_buf_bytes += nbytes
+            full = (len(self._put_buf) >= 2 * _PUT_FLUSH_COUNT
+                    or self._put_buf_bytes >= _PUT_FLUSH_BYTES)
+        if full:
+            self.flush_puts()
+
+    def _drain_put_buffer(self) -> list:
+        with self._put_lock:
+            buf, self._put_buf = self._put_buf, []
+            self._put_buf_bytes = 0
+        return buf
+
+    def flush_puts(self):
+        # Drain under send_lock: a drained-but-unwritten batch here must
+        # not let a concurrent _send (whose message may reference one of
+        # these puts) overtake it on the wire.
+        with self.send_lock:
+            buf = self._drain_put_buffer()
+            protocol.send_batch(self.conn, buf)
+
     def serialize_value(self, value, object_id: ObjectID):
         """By-value task args travel inline or as parts inside the spec —
-        never via a client-local shm segment nobody else can map."""
+        never via a client-local shm segment nobody else can map.
+
+        bytes() SNAPSHOT, deliberately: unlike put_object (whose message
+        pickles synchronously before return), a spec can sit UNPICKLED
+        in lease queues / dep-wait lists and be (re)pickled much later —
+        live PickleBuffer views would capture the caller's buffer at
+        push time, so a mutation after .remote() (reused rollout
+        buffers) would tear the argument."""
         res = serialization.dumps_adaptive(value, self.max_inline)
         if res[0] == "inline":
             return (protocol.INLINE, res[1])
@@ -69,6 +168,7 @@ class ClientRuntime(_WorkerRuntime):
 
     def disconnect(self):
         try:
+            self.flush_puts()
             self.flush_decrefs()
         except Exception:
             pass
@@ -76,6 +176,11 @@ class ClientRuntime(_WorkerRuntime):
             self.conn.close()
         except Exception:
             pass
+        for pools in (self._puller, self._pusher):
+            try:
+                pools.close()
+            except Exception:
+                pass
         from ray_tpu._private import api_internal
 
         if api_internal.get_runtime() is self:
@@ -109,10 +214,20 @@ def client_connect(address: str, authkey: bytes,
     # explicitly: the env setdefault above must not leave a stale key
     # from an earlier session on the pull path.
     rt._puller._authkey = authkey
+    rt._pusher._authkey = authkey
     protocol.send(conn, ("client_ready", os.urandom(16).hex()))
     msg = protocol.recv(conn)
     assert msg[0] == "client_ack", msg
     rt.store_id = f"client-{os.urandom(4).hex()}"  # nothing shares it
+    # Direct-put bootstrap (this release's heads): the head's store
+    # identity + object-server address + advertised verbs.  An old
+    # 2-tuple ack leaves _head_put_info None — every put then rides the
+    # legacy put_parts path, and no new verb is ever sent.
+    info = msg[2] if len(msg) > 2 else {}
+    if isinstance(info, dict) and info.get("object_addr") \
+            and info.get("store_id"):
+        rt._head_put_info = (info["store_id"], info["object_addr"],
+                             tuple(info.get("object_caps") or ()))
 
     def handle(m):
         tag = m[0]

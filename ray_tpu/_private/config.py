@@ -69,6 +69,26 @@ class Config:
     # listen_host.
     object_advertise_host: str = ""
 
+    # --- Direct puts (the WRITE-direction twin of the pooled/striped
+    # pull path; reference: plasma CreateObject/Seal on a dedicated
+    # store socket — writes never ride a GCS RPC).  Master switch: a
+    # client/worker put of a value destined for another store pushes
+    # the payload over the data plane (reserve_put/put_range/commit_put
+    # on the destination's object server) and sends only an O(1)
+    # ("put_commit", ...) control message.  Off = the legacy whole-value
+    # ("put_parts", ...) control message, byte-identical, with every
+    # direct-put counter zero. ---
+    direct_puts: bool = True
+    # A pushed value at least this big is streamed as concurrent
+    # byte-range stripes of this length over multiple pooled
+    # connections (needs the peer's "put_range" capability); smaller
+    # direct puts stream whole on one pooled connection.  0 disables
+    # striping (whole-value streams only).
+    object_put_stripe_threshold: int = 32 * 1024 * 1024
+    # Connections kept per destination object server for pushes.  0 =
+    # inherit object_pool_size (one sizing knob for both directions).
+    object_put_pool_size: int = 0
+
     # --- Locality-aware scheduling (reference:
     # scheduling/policy/hybrid_scheduling_policy.cc — lease selection
     # prefers the node holding the task's argument bytes).  The default

@@ -37,15 +37,20 @@ from ray_tpu._private.shm_store import ShmStore
 
 
 class _AgentStoreProxy:
-    """Attach-only store view that always resolves the agent's CURRENT
-    store — it is re-created with the session id after the head's ack,
-    and the object server may accept consumers on both sides of that."""
+    """Store view that always resolves the agent's CURRENT store — it is
+    re-created with the session id after the head's ack, and the object
+    server may accept consumers on both sides of that.  Reads attach;
+    the only write path is the direct-put reservation (pushed values
+    land here as public segments for this node's workers)."""
 
     def __init__(self, agent: "NodeAgent"):
         self._agent = agent
 
     def attach(self, name: str):
         return self._agent.store.attach(name)
+
+    def reserve_put(self, oid_bin: bytes, total: int):
+        return self._agent.store.reserve_put(oid_bin, total)
 
 
 class NodeAgent:
@@ -193,9 +198,20 @@ class NodeAgent:
         # empty (see _memory_monitor).
         self.head_config = msg[3] if len(msg) > 3 else {}
         self._handshake_done.set()
-        # Attach-only store for read_segment (segments here are created by
-        # this node's workers; the agent never allocates).
-        self.store = ShmStore(shm_dir=self.shm_dir, session_id=self.session)
+        # Store for read_segment + direct-put ingest.  Segments here are
+        # otherwise created by this node's workers; the agent allocates
+        # only put reservations — under the same NODE capacity the
+        # workers get (shared flock'd counter), so pushed ingest cannot
+        # overcommit tmpfs past what local puts respect, and an
+        # over-capacity reservation degrades to this node's spill dir.
+        self.store = ShmStore(shm_dir=self.shm_dir, session_id=self.session,
+                              capacity=self._node_store_bytes())
+        # Same node-local spill dir this node's workers resolve
+        # (worker_main): the env override when set, else the per-session
+        # default — so degraded put ingest lands where local spills do.
+        self.store.spill_dir = os.environ.get(
+            "RAY_TPU_SPILL_DIR_OVERRIDE",
+            f"/tmp/ray_tpu_spill_{self.session}")
 
     def _object_server(self):
         object_transfer.accept_loop(self._obj_listener,
@@ -266,25 +282,35 @@ class NodeAgent:
         except (SystemExit, Exception):
             return False
 
+    def _node_store_bytes(self) -> int:
+        """THIS node's store cap: the explicit env override, else 80% of
+        the store filesystem (so an uncapped node can't fill tmpfs and
+        die — per-node spilling engages instead).  Shared by worker
+        spawns and the agent's own put-reservation admission."""
+        if "RAY_TPU_STORE_BYTES" in os.environ:
+            return int(os.environ["RAY_TPU_STORE_BYTES"] or 0)
+        import shutil as _shutil
+
+        try:
+            return int(_shutil.disk_usage(self.shm_dir).total * 0.8)
+        except OSError:
+            return 0
+
     def _spawn_worker(self, worker_id_hex: str, env_overrides: Dict[str, str]):
         env = dict(os.environ)
         env.update(env_overrides)
         env["RAY_TPU_SHM_DIR_OVERRIDE"] = self.shm_dir
         env["RAY_TPU_STORE_ID"] = self.store_id
-        # THIS node's store policy wins over head defaults: its cap
-        # (default: 80% of the store filesystem, so an uncapped node
-        # can't fill tmpfs and die — per-node spilling engages instead)
-        # and its pool setting.
+        # THIS node's store policy wins over head defaults (see
+        # _node_store_bytes) — and matches the agent's own put-ingest
+        # admission gate.  An explicit env value is forwarded VERBATIM
+        # ("0" means uncapped and must reach the workers as such).
         if "RAY_TPU_STORE_BYTES" in os.environ:
             env["RAY_TPU_STORE_BYTES"] = os.environ["RAY_TPU_STORE_BYTES"]
         else:
-            import shutil as _shutil
-
-            try:
-                total = _shutil.disk_usage(self.shm_dir).total
-                env["RAY_TPU_STORE_BYTES"] = str(int(total * 0.8))
-            except OSError:
-                pass
+            cap = self._node_store_bytes()
+            if cap:
+                env["RAY_TPU_STORE_BYTES"] = str(cap)
         if "RAY_TPU_POOL_BYTES" in os.environ:
             env["RAY_TPU_POOL_BYTES"] = os.environ["RAY_TPU_POOL_BYTES"]
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -25,6 +25,15 @@ Zero-copy receive: the receiver reserves its destination buffer up front
 and ``recv_bytes_into``\\ s every chunk straight into it at its final
 offset.  Receive is one copy end-to-end, like the send side (which
 streams ``memoryview`` slices of the source mmap).
+
+Write direction (direct puts; reference: plasma ``CreateObject``/
+``Seal`` on the store socket): ``ObjectPusher`` streams a serialized
+value INTO a peer's store through the same pooled connections — a
+``reserve_put`` preallocates the PUBLIC destination segment (spill-aware
+admission in the store), ``put_range`` stripes recv straight into the
+mapping at final offsets, ``commit_put`` seals it.  The control plane
+then carries only an O(1) ``put_commit`` descriptor registration.  All
+put verbs ride the same CAPS advertisement as ``fetch_range``.
 """
 
 from __future__ import annotations
@@ -32,6 +41,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -42,10 +52,24 @@ logger = logging.getLogger(__name__)
 
 CHUNK = 1 << 20  # 1 MB, the reference's object-manager chunk size
 
+# Write-direction verbs (direct puts): a pusher streams a value's bytes
+# into a reservation on the destination store.  Advertised together —
+# a pusher engages only against peers declaring ALL of them.
+PUT_CAPS: Tuple[str, ...] = ("reserve_put", "put_range", "commit_put",
+                             "abort_put")
+
 # Verbs this side's object server speaks beyond the original "fetch".
-# Advertised out of band (agent_ready info / store_addr replies) so pullers
-# never probe a peer with a verb it would silently ignore.
-CAPS: Tuple[str, ...] = ("fetch_range",)
+# Advertised out of band (agent_ready info / store_addr / client_ack
+# replies) so pullers and pushers never probe a peer with a verb it
+# would silently ignore.
+CAPS: Tuple[str, ...] = ("fetch_range",) + PUT_CAPS
+
+
+def peer_accepts_puts(caps) -> bool:
+    """True when the peer's advertised verb set covers the whole direct-
+    put lifecycle — the capability gate that keeps old-verb-only peers
+    on the legacy ``put_parts`` path without ever seeing a new verb."""
+    return all(v in caps for v in PUT_CAPS)
 
 # Segment names whose metadata table failed to parse in _true_extent —
 # each is logged once at debug level (bounded; see below).
@@ -79,9 +103,15 @@ def _true_extent(view: memoryview, name: str = "?") -> int:
 
 
 def serve_connection(conn, store):
-    """Agent-side loop for one consumer connection: stream requested
-    segments (or byte ranges of them) chunk by chunk (reference:
-    ObjectManager::Push)."""
+    """Agent-side loop for one consumer/producer connection: stream
+    requested segments (or byte ranges of them) chunk by chunk
+    (reference: ObjectManager::Push), and receive pushed puts into
+    store reservations (reference: plasma CreateObject/Seal on the
+    store socket).  ``reserved`` tracks reservations made on THIS
+    connection so a pusher dying between ``reserve_put`` and
+    ``commit_put`` (its socket closes) triggers the abort cleanup —
+    no leaked segments, accounting restored."""
+    reserved: set = set()
     try:
         while True:
             msg = protocol.recv(conn)
@@ -123,11 +153,57 @@ def serve_connection(conn, store):
                 finally:
                     del mv
                     seg.close()
+            elif msg[0] == "reserve_put":
+                # Direct-put reservation: preallocate the destination
+                # mapping (public segment; spill-aware admission happens
+                # in the store) and reply with its canonical name —
+                # stripes and the commit address it by name, possibly
+                # over OTHER pooled connections.
+                _tag, oid_bin, total = msg
+                try:
+                    name = _puts_for(store).reserve(oid_bin, total)
+                except Exception as e:  # noqa: BLE001
+                    protocol.send(conn, ("err", repr(e)))
+                    continue
+                reserved.add(name)
+                protocol.send(conn, ("ok", name))
+            elif msg[0] == "put_range":
+                # One byte-range stripe of a pending put: the payload
+                # chunks following this message land straight in the
+                # reserved mapping at their final offsets (socket ->
+                # mmap, one copy).  The ack is the pusher's durability
+                # signal for this range.
+                _tag, name, off, length = msg
+                if _puts_for(store).write(name, conn, off, length):
+                    protocol.send(conn, ("ok", length))
+                else:
+                    protocol.send(conn, ("err",
+                                         f"no pending put {name!r}"))
+            elif msg[0] == "commit_put":
+                name = msg[1]
+                reserved.discard(name)
+                try:
+                    kind, ident, total = _puts_for(store).commit(name)
+                except Exception as e:  # noqa: BLE001
+                    protocol.send(conn, ("err", repr(e)))
+                    continue
+                protocol.send(conn, ("ok", kind, ident, total))
+            elif msg[0] == "abort_put":
+                reserved.discard(msg[1])
+                _puts_for(store).abort(msg[1])
+                protocol.send(conn, ("ok",))
             elif msg[0] == "close":
                 return
     except (EOFError, OSError, TypeError):
         return
     finally:
+        for name in reserved:
+            # Reserving connection died/closed without commit: tear the
+            # reservation down (pusher-death hygiene).
+            try:
+                _puts_for(store).abort(name)
+            except Exception:
+                pass
         try:
             conn.close()
         except Exception:
@@ -150,6 +226,136 @@ def accept_loop(listener, store, stopped, conn_name: str):
             continue
         threading.Thread(target=serve_connection, args=(conn, store),
                          daemon=True, name=conn_name).start()
+
+
+# One server-side put registry per store instance, shared by every
+# consumer connection of that store's object server (reservation on one
+# connection, stripes on others).  Keyed weakly so a retired store (agent
+# re-registration) drops its registry with it.
+_put_registries: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_put_registries_lock = threading.Lock()
+
+
+def _puts_for(store) -> "PutRegistry":
+    with _put_registries_lock:
+        reg = _put_registries.get(store)
+        if reg is None:
+            reg = _put_registries[store] = PutRegistry(store)
+        return reg
+
+
+class PutRegistry:
+    """Pending direct puts on ONE destination store (server side).
+
+    A put's lifecycle spans multiple connections: ``reserve_put`` on the
+    pusher's primary connection creates the entry, ``put_range`` stripes
+    arrive on any pooled connection and recv straight into the shared
+    mapping at disjoint offsets, ``commit_put``/``abort_put`` retire it.
+
+    LOCK ORDER (checked by tests/test_lockcheck.py): ``_lock`` is an
+    INDEPENDENT LEAF — it guards only the entry table and each entry's
+    writer count / dead flag; reservation (file create), the stripe
+    recv streaming, and the mapping teardown all run OUTSIDE it.  The
+    writer count is what makes ``abort`` safe against in-flight stripes:
+    the mapping is closed by the aborter only at writer count zero,
+    else by the last draining writer.
+    """
+
+    def __init__(self, store):
+        # weakref, NOT a strong reference: the registry is the VALUE in
+        # a WeakKeyDictionary keyed by this store — a strong value->key
+        # path would pin retired stores (and their registries) forever.
+        # Pending reservations still legitimately pin the store through
+        # their own PutReservation.store until resolved.
+        self._store_ref = weakref.ref(store)
+        self._lock = threading.Lock()
+        self._pending: dict = {}  # name -> shm_store.PutReservation
+
+    def reserve(self, oid_bin: bytes, total: int) -> str:
+        store = self._store_ref()
+        if store is None:
+            raise OSError("destination store retired")
+        res = store.reserve_put(oid_bin, total)
+        with self._lock:
+            if res.name in self._pending:
+                dup = True
+            else:
+                dup = False
+                self._pending[res.name] = res
+        if dup:  # same object pushed twice concurrently: refuse the 2nd
+            res.abort()
+            raise ValueError(f"put already pending for {res.name}")
+        return res.name
+
+    def write(self, name: str, conn, off: int, length: int) -> bool:
+        """Receive one stripe's payload into the reservation; returns
+        False (after draining the payload, keeping the connection in
+        sync) when the reservation is gone/dead or the range is out of
+        bounds."""
+        with self._lock:
+            res = self._pending.get(name)
+            if (res is None or res.dead or off < 0 or length < 0
+                    or off + length > res.total):
+                res = None
+            else:
+                res.writers += 1
+        if res is None:
+            _drain_discard(conn, length)
+            return False
+        try:
+            view = memoryview(res.mm)
+            try:
+                _recv_range(conn, view, off, length)
+            finally:
+                del view
+        finally:
+            dispose = False
+            with self._lock:
+                res.writers -= 1
+                if res.dead and res.writers == 0:
+                    dispose = True
+            if dispose:
+                res.abort()
+        return True
+
+    def commit(self, name: str):
+        with self._lock:
+            res = self._pending.pop(name, None)
+        if res is None:
+            raise ValueError(f"no pending put {name!r}")
+        res.commit()
+        return res.kind, res.ident, res.total
+
+    def abort(self, name: str) -> bool:
+        """Tear down a pending reservation; returns True when one was
+        found (its file/accounting teardown is owned by this call or —
+        with stripes still draining — by the last writer)."""
+        dispose = None
+        with self._lock:
+            res = self._pending.pop(name, None)
+            if res is not None:
+                if res.writers > 0:
+                    res.dead = True  # last draining writer disposes
+                else:
+                    dispose = res
+        if dispose is not None:
+            dispose.abort()
+        return res is not None
+
+
+def _drain_discard(conn, n: int):
+    """Consume and discard ``n`` payload bytes from a desynced-put
+    stripe so the connection stays at a message boundary for the error
+    reply."""
+    from multiprocessing import BufferTooShort
+
+    scratch = bytearray(CHUNK)
+    got = 0
+    while got < n:
+        try:
+            got += conn.recv_bytes_into(scratch)
+        except BufferTooShort as e:
+            got += len(e.args[0])
 
 
 class _ConnPool:
@@ -253,11 +459,9 @@ class _ConnPool:
                 pass
 
 
-class ObjectPuller:
-    """Consumer-side client: pooled connections to home-store object
-    servers, pulling segments as chunk streams — whole segments or
-    concurrent byte-range stripes (reference: ObjectManager::Pull +
-    ObjectBufferPool chunk assembly with multiple chunks in flight).
+class _PoolHost:
+    """Per-peer connection-pool registry shared by the pull and push
+    sides (ObjectPuller / ObjectPusher).
 
     LOCK ORDER (checked by tests/test_lockcheck.py via devtools.lockcheck):
     the registry ``_lock`` and every pool's condition lock are INDEPENDENT
@@ -267,20 +471,14 @@ class ObjectPuller:
     condition guards only that pool's idle list and connection count and
     is never held across a dial or any stream I/O.  Streaming itself runs
     on an exclusively-acquired connection and holds NO lock at all — this
-    is what lets N transfers from one peer proceed in parallel where the
-    old design serialized them behind one per-connection lock held for
-    the whole stream.
+    is what lets N transfers to/from one peer proceed in parallel where
+    the old design serialized them behind one per-connection lock held
+    for the whole stream.
     """
 
-    def __init__(self, authkey: bytes, pool_size: Optional[int] = None,
-                 stripe_threshold: Optional[int] = None):
-        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
-
+    def __init__(self, authkey: bytes, pool_size: int):
         self._authkey = authkey
-        self._pool_size = (pool_size if pool_size is not None
-                           else _cfg.object_pool_size)
-        self._stripe = (stripe_threshold if stripe_threshold is not None
-                        else _cfg.object_stripe_threshold)
+        self._pool_size = pool_size
         self._pools: Dict[str, _ConnPool] = {}  # store_id -> pool
         self._lock = threading.Lock()
 
@@ -303,6 +501,31 @@ class ObjectPuller:
             pool = self._pools.pop(store_id, None)
         if pool is not None:
             pool.close()
+
+    def close(self):
+        with self._lock:
+            pools, self._pools = list(self._pools.values()), {}
+        for pool in pools:
+            pool.close()
+
+
+class ObjectPuller(_PoolHost):
+    """Consumer-side client: pooled connections to home-store object
+    servers, pulling segments as chunk streams — whole segments or
+    concurrent byte-range stripes (reference: ObjectManager::Pull +
+    ObjectBufferPool chunk assembly with multiple chunks in flight).
+    Lock conventions: see _PoolHost.
+    """
+
+    def __init__(self, authkey: bytes, pool_size: Optional[int] = None,
+                 stripe_threshold: Optional[int] = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+        super().__init__(authkey,
+                         pool_size if pool_size is not None
+                         else _cfg.object_pool_size)
+        self._stripe = (stripe_threshold if stripe_threshold is not None
+                        else _cfg.object_stripe_threshold)
 
     # ------------------------------------------------------------ fetch --
     def fetch(self, store_id: str, addr: str, name: str, sink=None,
@@ -426,11 +649,217 @@ class ObjectPuller:
             raise errors[0]
         return buf
 
-    def close(self):
-        with self._lock:
-            pools, self._pools = list(self._pools.values()), {}
-        for pool in pools:
-            pool.close()
+
+class PutUnsupportedError(RuntimeError):
+    """The destination's advertised caps lack the put verbs — the caller
+    keeps the legacy ``put_parts`` control-plane path (never probed)."""
+
+
+class _StripeError(Exception):
+    """A HELPER stripe connection failed; the primary connection is at a
+    message boundary (safe to send ``abort_put`` on it)."""
+
+
+class ObjectPusher(_PoolHost):
+    """Producer-side twin of ObjectPuller: stream a serialized value
+    straight into a reservation on the destination store's object server
+    — whole on one pooled connection, or as concurrent byte-range
+    stripes over several (reference: plasma CreateObject/Seal through
+    the store socket; writes never ride the control plane).
+
+    The pusher computes the destination segment's exact on-disk image
+    locally (``shm_store.segment_layout`` — header+table+aligned
+    buffers) and streams byte ranges of that LOGICAL image without ever
+    materializing it: each range walks the source buffer views, with
+    alignment/padding gaps sent as zeros.  One copy end-to-end
+    (source buffer -> socket -> destination mmap).
+
+    Failure hygiene mirrors the pull side: a mid-stream error evicts
+    ONLY the broken pooled connection; a reservation whose push failed
+    is aborted — explicitly via ``abort_put`` when the primary
+    connection is at a message boundary, else implicitly by the server's
+    reserving-connection-close cleanup.  Lock conventions: _PoolHost.
+    """
+
+    def __init__(self, authkey: bytes, pool_size: Optional[int] = None,
+                 stripe_threshold: Optional[int] = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+        super().__init__(authkey,
+                         pool_size if pool_size is not None
+                         else (_cfg.object_put_pool_size
+                               or _cfg.object_pool_size))
+        self._stripe = (stripe_threshold if stripe_threshold is not None
+                        else _cfg.object_put_stripe_threshold)
+
+    def push(self, store_id: str, addr: str, oid_bin: bytes, meta,
+             buffers, caps: Tuple[str, ...] = ()):
+        """Push one serialized value (``meta`` + out-of-band buffer
+        views) into ``store_id``'s store; returns ``(kind, ident,
+        total)`` — kind ``"shm"``/``"spilled"``, ident the segment name
+        or spill path, total the committed byte size — for the caller's
+        ``("put_commit", ...)`` control message.  Raises
+        PutUnsupportedError (without any wire traffic) when the peer
+        does not advertise the put verbs."""
+        if not peer_accepts_puts(caps):
+            raise PutUnsupportedError(
+                f"peer {store_id} does not speak the put verbs")
+        from ray_tpu._private.shm_store import segment_layout
+
+        meta = bytes(meta)
+        table, offsets, total = segment_layout(meta, buffers)
+        head = bytearray(_HEADER.size)
+        _HEADER.pack_into(head, 0, _MAGIC, len(table))
+        # Header and table as separate pieces: for a buffer-less value
+        # the whole meta lives in the (multi-MB) table pickle, and
+        # concatenating would copy it once more before streaming.
+        pieces = [(0, memoryview(head)), (_HEADER.size, memoryview(table))]
+        pieces += [(off, memoryview(b).cast("B"))
+                   for off, b in zip(offsets, buffers)]
+        pool = self._pool_for(store_id, addr)
+        conn = pool.acquire()
+        name = None
+        boundary = True  # primary conn at a message boundary?
+        try:
+            protocol.send(conn, ("reserve_put", oid_bin, total))
+            reply = protocol.recv(conn)
+            if reply[0] != "ok":
+                raise OSError(f"put refused by {store_id}: {reply!r}")
+            name = reply[1]
+            stripe = self._stripe
+            try:
+                boundary = False
+                if stripe > 0 and total > stripe:
+                    self._push_striped(pool, conn, name, pieces, total,
+                                       stripe)
+                else:
+                    _push_range(conn, name, pieces, 0, total)
+                boundary = True
+            except _StripeError:
+                boundary = True  # helpers failed; primary drained clean
+                raise
+            protocol.send(conn, ("commit_put", name))
+            reply = protocol.recv(conn)
+            if reply[0] != "ok":
+                raise OSError(f"put commit failed at {store_id}: "
+                              f"{reply!r}")
+            kind, ident, size = reply[1], reply[2], reply[3]
+        except BaseException:
+            # Best-effort explicit abort when the primary stream is at a
+            # message boundary; otherwise evicting the (reserving)
+            # connection makes the server's close-cleanup abort it.
+            if name is not None and boundary:
+                try:
+                    protocol.send(conn, ("abort_put", name))
+                    protocol.recv(conn)
+                except Exception:
+                    pass
+            pool.evict(conn)
+            raise
+        pool.release(conn)
+        return kind, ident, size
+
+    def _push_striped(self, pool: _ConnPool, conn, name: str, pieces,
+                      total: int, stripe: int):
+        """Concurrent byte-range stripes: this thread drains ranges on
+        the primary connection; helpers drain on additional pooled
+        connections (same shape as ObjectPuller._fetch_striped, pointed
+        the other way)."""
+        ranges = deque((off, min(stripe, total - off))
+                       for off in range(0, total, stripe))
+        errors: list = []
+
+        def drain(c):
+            while not errors:
+                try:
+                    off, length = ranges.popleft()
+                except IndexError:
+                    return
+                _push_range(c, name, pieces, off, length)
+
+        def helper():
+            # A busy pool is not an error: give up quickly and let the
+            # primary connection finish the remaining ranges.
+            try:
+                c = pool.acquire(timeout=0.25)
+            except OSError:
+                return
+            if c is None:
+                return
+            try:
+                drain(c)
+            except BaseException as e:  # noqa: BLE001 — joined below
+                errors.append(e)
+                pool.evict(c)
+                return
+            pool.release(c)
+
+        helpers = [
+            threading.Thread(target=helper, daemon=True,
+                             name="rtpu-put-stripe")
+            for _ in range(min(len(ranges) - 1, self._pool_size - 1))
+        ]
+        for t in helpers:
+            t.start()
+        try:
+            drain(conn)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errors.append(e)  # helpers stop at their next range
+            raise
+        finally:
+            for t in helpers:
+                t.join()
+        if errors:
+            # Primary drained clean (or we'd have raised above): wrap so
+            # the caller knows an explicit abort_put is safe.
+            raise _StripeError() from errors[0]
+
+
+_ZEROS = bytes(1 << 14)
+
+
+def _push_range(conn, name: str, pieces, off: int, n: int):
+    """One put_range exchange: header, exactly ``n`` payload bytes of
+    the logical segment image in ≤CHUNK messages, ack."""
+    protocol.send(conn, ("put_range", name, off, n))
+    _send_piece_range(conn, pieces, off, n)
+    reply = protocol.recv(conn)
+    if reply[0] != "ok" or reply[1] != n:
+        raise OSError(f"put_range [{off}, {off + n}) of {name} refused: "
+                      f"{reply!r}")
+
+
+def _send_piece_range(conn, pieces, off: int, n: int):
+    """Stream bytes [off, off+n) of the logical segment image.
+    ``pieces`` is a sorted list of (offset, memoryview); bytes covered
+    by no piece (alignment gaps, table padding) are zeros."""
+    end = off + n
+    pos = off
+    for poff, view in pieces:
+        plen = len(view)
+        if poff + plen <= pos:
+            continue
+        if poff >= end:
+            break
+        if poff > pos:
+            _send_zeros(conn, poff - pos)
+            pos = poff
+        lo = pos - poff
+        hi = min(plen, end - poff)
+        for o in range(lo, hi, CHUNK):
+            conn.send_bytes(view[o:min(o + CHUNK, hi)])
+        pos = poff + hi
+        if pos >= end:
+            break
+    if pos < end:
+        _send_zeros(conn, end - pos)
+
+
+def _send_zeros(conn, n: int):
+    while n > 0:
+        m = min(n, len(_ZEROS))
+        conn.send_bytes(_ZEROS if m == len(_ZEROS) else _ZEROS[:m])
+        n -= m
 
 
 def _recv_range(conn, view: memoryview, off: int, n: int):

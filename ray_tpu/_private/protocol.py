@@ -26,6 +26,17 @@ worker → driver
   ("submit", 0, spec: dict)         nested task submission (fire-and-forget;
                                     per-conn FIFO makes later uses safe)
   ("put",    object_id_bytes, descr, nested_ids)
+  ("put_parts", object_id_bytes, meta, [buffers], nested_ids)
+                                    legacy client put: whole value in one
+                                    control message, head assembles
+  ("put_commit", object_id_bytes, descr, nested_ids)
+                                    direct put: the payload already
+                                    streamed into the destination store
+                                    over the object-server data plane
+                                    (reserve_put/put_range/commit_put/
+                                    abort_put verbs, capability-gated);
+                                    the control plane sees only this
+                                    O(1) descriptor registration
   ("addref", object_id_bytes) / ("decref", object_id_bytes)
   ("decref_batch", [object_id_bytes])   buffered ref drops
   ("blocked", task_id_bytes) / ("unblocked", task_id_bytes)

@@ -451,6 +451,23 @@ class Runtime:
             stripe_threshold=config.object_stripe_threshold)
         self.relayed_segments = 0   # head-relayed agent reads (fallback)
         self.brokered_parts = 0     # worker getparts served via the head
+        # Write-direction counters (all zero while direct_puts is off —
+        # pinned by tests): direct_puts/direct_put_bytes = values that
+        # reached this store over the data plane (the head saw only the
+        # O(1) put_commit message); brokered_put_parts = legacy
+        # whole-value put_parts messages assembled here while the direct
+        # path was ON (old-verb clients, push failures, and mid-size
+        # puts under the client's direct-put floor — a few MB, where
+        # the fire-and-forget message beats three round trips).
+        self.direct_puts = 0
+        self.direct_put_bytes = 0
+        self.brokered_put_parts = 0
+        # Legacy put_parts assemblies run off the reader threads but
+        # BOUNDED: past this many in flight the reader blocks before
+        # spawning (TCP backpressure then throttles the bursting
+        # client), so a legacy-put storm cannot pin unbounded buffer
+        # memory in concurrent multi-hundred-MB memcpys.
+        self._put_assembly_sem = threading.BoundedSemaphore(4)
         # Locality-aware placement counters (tentpole observability):
         # hits = tasks placed on their top-locality node, misses = a
         # preference existed but that node couldn't take the task,
@@ -488,6 +505,9 @@ class Runtime:
         self.store_id = os.urandom(8).hex()
         self.spill_dir = (config.spill_dir
                           or f"/tmp/ray_tpu_spill_{self.session_id}")
+        # Direct-put reservations degrade to the spill path (instead of
+        # overcommitting tmpfs) through the store's spill_dir.
+        self.shm.spill_dir = self.spill_dir
         self._stopped = False
         self._extra_workers = 0
 
@@ -926,17 +946,68 @@ class Runtime:
                 return (protocol.SPILLED, path, size, self.store_id)
         return (protocol.SHM, name, size, self.store_id)
 
+    def _clear_stale_put_segment(self, oid: ObjectID):
+        """A failed direct push can strand the oid's canonical segment
+        (the server committed but the ack was lost, or the abort cleanup
+        is still draining server-side) — and the put_parts FALLBACK for
+        the same oid then collides with it.  This put owns the name:
+        clear any pending reservation, and for a committed remnant
+        unlink it (restoring accounting) before assembling the
+        fallback."""
+        name = self.shm.segment_name(oid)
+        path = os.path.join(self.shm._dir, name)
+        # The spill-degraded reservation commits under spill_dir instead.
+        spath = (os.path.join(self.spill_dir, name)
+                 if self.spill_dir else None)
+        spath = spath if spath and os.path.exists(spath) else None
+        if not os.path.exists(path) and spath is None:
+            return
+        pending = False
+        try:
+            pending = object_transfer._puts_for(self.shm).abort(name)
+        except Exception:
+            pass
+        if pending:
+            # The reservation teardown (possibly deferred to the last
+            # draining stripe writer) owns the file + accounting; wait
+            # briefly for it to land rather than double-rolling-back.
+            deadline = time.monotonic() + 2.0
+            while os.path.exists(path) and time.monotonic() < deadline:
+                time.sleep(0.01)
+            return
+        if spath is not None:
+            try:
+                os.unlink(spath)  # spill files are not store-accounted
+            except OSError:
+                pass
+        try:
+            size = os.stat(path).st_size
+        except OSError:
+            return  # shm remnant already gone
+        self.shm.unlink(name, size)
+
     def _store_parts_locally(self, oid: ObjectID, meta: bytes, bufs):
         """Pre-serialized parts into the driver store (client puts),
         with the same spill fallback as serialize_value."""
         views = [memoryview(b) for b in bufs]
+        self._clear_stale_put_segment(oid)
+
+        def create():
+            try:
+                return self.shm.create_from_parts(oid, meta, views)
+            except FileExistsError:
+                # Raced a direct-push remnant that landed after the
+                # clear above: clear again and retry once.
+                self._clear_stale_put_segment(oid)
+                return self.shm.create_from_parts(oid, meta, views)
+
         try:
-            name, size = self.shm.create_from_parts(oid, meta, views)
+            name, size = create()
         except MemoryError:
             need = sum(len(b) for b in bufs) + len(meta) + 65536
             self._spill_objects(need)
             try:
-                name, size = self.shm.create_from_parts(oid, meta, views)
+                name, size = create()
             except MemoryError:
                 path, size = self.shm.create_spilled(
                     oid, meta, views, self.spill_dir)
@@ -1016,6 +1087,23 @@ class Runtime:
             st.nested_ids = nested
             self._pin_nested_locked(nested)
         return ObjectRef(oid, _register=False)
+
+    def _register_put_locked(self, oid: ObjectID, st: ObjectState,
+                             descr, ok: bool):
+        """Publish a client-put descriptor: READY + wake waiters, but —
+        unlike task-result completion — WITHOUT the maybe-free check: a
+        fresh put's refcount is 0 until the client's addref (the very
+        next message on its FIFO connection) lands, and freeing in that
+        window would strand the ref forever."""
+        st.status = READY if ok else ERRORED
+        st.descr = descr
+        futures, st.futures = st.futures, []
+        waiters, st.waiters = st.waiters, []
+        for f in futures:
+            if not f.done():
+                f.set_result(oid)
+        for cb in waiters:
+            cb(oid)
 
     def _complete_object_locked(self, oid: ObjectID, descr, ok: bool,
                                 creator=None):
@@ -2001,6 +2089,12 @@ class Runtime:
             "RAY_TPU_OBJECT_POOL_SIZE": str(self.config.object_pool_size),
             "RAY_TPU_OBJECT_STRIPE_THRESHOLD":
                 str(self.config.object_stripe_threshold),
+            "RAY_TPU_DIRECT_PUTS":
+                "1" if self.config.direct_puts else "0",
+            "RAY_TPU_OBJECT_PUT_STRIPE_THRESHOLD":
+                str(self.config.object_put_stripe_threshold),
+            "RAY_TPU_OBJECT_PUT_POOL_SIZE":
+                str(self.config.object_put_pool_size),
             "RAY_TPU_ARG_PREFETCH_DEPTH":
                 str(self.config.arg_prefetch_depth),
             "RAY_TPU_STREAMING_EXECUTOR":
@@ -2145,6 +2239,17 @@ class Runtime:
                                     lambda: self._stopped,
                                     "ray_tpu-objconn")
 
+    def _adv_caps(self, caps) -> tuple:
+        """Advertised object-server verbs, with the put verbs withheld
+        while ``direct_puts`` is off — pushers are capability-gated, so
+        not advertising IS the off switch (the legacy put_parts path,
+        byte-identical, every direct-put counter zero)."""
+        caps = tuple(caps or ())
+        if self.config.direct_puts:
+            return caps
+        return tuple(c for c in caps
+                     if c not in object_transfer.PUT_CAPS)
+
     def _accept_loop(self, listener):
         while not self._stopped:
             try:
@@ -2171,7 +2276,19 @@ class Runtime:
                 w.ready.set()
                 with self.lock:
                     self._conn_to_worker[conn] = w
-                protocol.send(conn, ("client_ack", self.session_id))
+                # The ack's info dict is the client's direct-put
+                # bootstrap: with the head's store identity + object-
+                # server address + advertised verbs, a large client put
+                # streams straight into the head's store over the data
+                # plane.  Old clients ignore the extra element; a new
+                # client against an old (2-tuple-ack) head keeps the
+                # legacy put_parts path.
+                protocol.send(conn, ("client_ack", self.session_id, {
+                    "store_id": self.store_id,
+                    "object_addr": self.object_addr,
+                    "object_caps": list(self._adv_caps(
+                        object_transfer.CAPS)),
+                }))
                 threading.Thread(target=self._worker_reader,
                                  args=(conn, w), daemon=True,
                                  name="ray_tpu-rx-client").start()
@@ -3376,14 +3493,15 @@ class Runtime:
             # replying, which would hang the requester instead.
             _, rid, store_hex = msg
             if store_hex == self.store_id:
-                reply = (self.object_addr, object_transfer.CAPS)
+                reply = (self.object_addr,
+                         self._adv_caps(object_transfer.CAPS))
             else:
                 with self.lock:
                     agent = self._agents.get(store_hex)
                     alive = agent is not None and not agent.dead
                     addr = (agent.info.get("object_addr")
                             if alive else None)
-                    caps = (tuple(agent.info.get("object_caps") or ())
+                    caps = (self._adv_caps(agent.info.get("object_caps"))
                             if alive else ())
                 reply = (addr, caps) if addr else None
             self._queue_send(worker, ("reply", rid, reply))
@@ -3409,24 +3527,95 @@ class Runtime:
             except ValueError:
                 self._queue_send(worker, ("reply", rid, (False, None, None)))
         elif tag == "put_parts":
-            # Client-shipped value: land it in the HEAD's store so any
-            # worker can consume it (clients share no /dev/shm).
+            # Legacy client-shipped value: land it in the HEAD's store
+            # so any worker can consume it (clients share no /dev/shm).
+            # The table entry registers PENDING under the lock here (so
+            # later messages on this FIFO see the object), but the
+            # multi-hundred-MB assembly memcpy runs OFF this reader
+            # thread and outside the runtime lock — the PR 6 lock-hold
+            # convention: the lock is held only for table registration.
             _, oid_bin, meta, bufs, nested = msg
             oid = ObjectID(oid_bin)
-            try:
-                descr = self._store_parts_locally(oid, meta, bufs)
-            except Exception as e:  # noqa: BLE001
-                descr = (protocol.ERROR, serialization.dumps_inline(
-                    exc.RayTpuError(f"client put failed: {e!r}")))
             with self.lock:
+                if self.config.direct_puts:
+                    # Counted only while the direct path is on: this
+                    # message is then a FALLBACK (old-verb client, push
+                    # failure) worth watching.
+                    self.brokered_put_parts += 1
                 st = self.objects.get(oid)
                 if st is None:
                     st = self.objects[oid] = ObjectState()
-                st.status = (READY if descr[0] != protocol.ERROR
-                             else ERRORED)
-                st.descr = descr
+                st.pins += 1  # assembly pin: no free mid-assembly
                 st.nested_ids = list(nested)
                 self._pin_nested_locked(st.nested_ids)
+
+            def assemble(oid=oid, meta=meta, bufs=bufs):
+                try:
+                    descr = self._store_parts_locally(oid, meta, bufs)
+                except Exception as e:  # noqa: BLE001
+                    descr = (protocol.ERROR, serialization.dumps_inline(
+                        exc.RayTpuError(f"client put failed: {e!r}")))
+                finally:
+                    self._put_assembly_sem.release()
+                with self.lock:
+                    st2 = self.objects.get(oid)
+                    if st2 is not None:
+                        st2.pins -= 1
+                        self._register_put_locked(
+                            oid, st2, descr, descr[0] != protocol.ERROR)
+                        drop_candidate = st2.refcount() <= 0
+                if st2 is not None and drop_candidate:
+                    # Refs dropped DURING assembly: the decref's free ran
+                    # into the assembly pin and deferred, and
+                    # _register_put_locked deliberately skips the free
+                    # check (the client's addref may still be in flight
+                    # on its FIFO conn, microseconds behind).  Re-check
+                    # after a beat — by then the addref has long landed
+                    # if it is ever coming — so a fire-and-forget client
+                    # put cannot leak its segment.
+                    def _late_free(oid=oid):
+                        with self.lock:
+                            st3 = self.objects.get(oid)
+                            if st3 is not None:
+                                self._maybe_free_locked(oid, st3)
+
+                    threading.Timer(1.0, _late_free).start()
+                if st2 is None:
+                    # Entry freed mid-assembly (ref dropped): don't leak
+                    # the just-written segment/spill file.
+                    if descr[0] == protocol.SHM:
+                        self.shm.unlink(descr[1], descr[2])
+                    elif descr[0] == protocol.SPILLED:
+                        try:
+                            os.unlink(descr[1])
+                        except OSError:
+                            pass
+
+            # Blocks this reader past the in-flight bound — deliberate:
+            # the bursting client's TCP window then backpressures it,
+            # as the old inline assembly did per connection.
+            self._put_assembly_sem.acquire()  # noqa: RTL401 -- cross-thread handoff: released in assemble()'s finally on the assembly thread
+            threading.Thread(target=assemble, daemon=True,
+                             name="ray_tpu-put-parts").start()
+        elif tag == "put_commit":
+            # Direct-put commit: the payload already streamed into this
+            # node's store over the data plane (object-server verbs
+            # reserve_put/put_range/commit_put) — the control plane sees
+            # only this O(1) descriptor registration, the write-direction
+            # analog of the head staying out of the pull payload path.
+            _, oid_bin, descr, nested = msg
+            oid = ObjectID(oid_bin)
+            with self.lock:
+                self.direct_puts += 1
+                if descr is not None and len(descr) > 2 \
+                        and isinstance(descr[2], int):
+                    self.direct_put_bytes += descr[2]
+                st = self.objects.get(oid)
+                if st is None:
+                    st = self.objects[oid] = ObjectState()
+                st.nested_ids = list(nested)
+                self._pin_nested_locked(st.nested_ids)
+                self._register_put_locked(oid, st, descr, True)
         elif tag in ("job_submit", "job_status", "job_logs", "job_stop",
                      "job_list"):
             from ray_tpu.job_submission import _get_manager
@@ -4572,6 +4761,9 @@ class Runtime:
                 "deduped_pulls": self.deduped_pulls,
                 "brokered_parts": self.brokered_parts,
                 "relayed_segments": self.relayed_segments,
+                "direct_puts": self.direct_puts,
+                "direct_put_bytes": self.direct_put_bytes,
+                "brokered_put_parts": self.brokered_put_parts,
                 "lease_grants": self.lease_grants,
                 "leased_submits": self.leased_submits,
                 "spillbacks": self.spillbacks,

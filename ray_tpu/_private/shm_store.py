@@ -85,6 +85,32 @@ def _segment_path(shm_dir: str, name: str) -> str:
     return os.path.join(shm_dir, name)
 
 
+def segment_layout(meta: bytes, buffers: List[memoryview]):
+    """(table_pickle, buffer_offsets, total_size) for the on-disk segment
+    layout: [header][table][aligned buffers...].  The table is pickled
+    together with the payload meta so readers need one load.  Two-pass:
+    compute offsets assuming a table pickle of the final length; table
+    size varies with offsets' magnitude only slightly, so pad
+    generously instead of iterating.  Module-level because the layout is
+    a WIRE contract too: a remote pusher (object_transfer.ObjectPusher)
+    computes the identical image so its byte-range stripes land at the
+    offsets local readers expect."""
+    sizes = [len(b) for b in buffers]
+    probe = serialization.dumps_inline(([0] * len(sizes), sizes, meta))
+    table_room = len(probe) + 256
+    base = _HEADER.size + table_room
+    offsets, total = serialization.aligned_offsets(sizes, base)
+    table = serialization.dumps_inline((offsets, sizes, meta))
+    if len(table) > table_room:
+        # Offsets grew the pickle beyond the pad (pathological); redo
+        # with exact room.
+        table_room = len(table) + 256
+        base = _HEADER.size + table_room
+        offsets, total = serialization.aligned_offsets(sizes, base)
+        table = serialization.dumps_inline((offsets, sizes, meta))
+    return table, offsets, total
+
+
 class Segment:
     """An open mapping of one shared object."""
 
@@ -238,26 +264,7 @@ class ShmStore:
         return name, alloc
 
     def _layout(self, meta: bytes, buffers: List[memoryview]):
-        """(table_pickle, buffer_offsets, total_size) for the segment
-        layout: [header][table][aligned buffers...].  The table is pickled
-        together with the payload meta so readers need one load.  Two-pass:
-        compute offsets assuming a table pickle of the final length; table
-        size varies with offsets' magnitude only slightly, so pad
-        generously instead of iterating."""
-        sizes = [len(b) for b in buffers]
-        probe = serialization.dumps_inline(([0] * len(sizes), sizes, meta))
-        table_room = len(probe) + 256
-        base = _HEADER.size + table_room
-        offsets, total = serialization.aligned_offsets(sizes, base)
-        table = serialization.dumps_inline((offsets, sizes, meta))
-        if len(table) > table_room:
-            # Offsets grew the pickle beyond the pad (pathological); redo
-            # with exact room.
-            table_room = len(table) + 256
-            base = _HEADER.size + table_room
-            offsets, total = serialization.aligned_offsets(sizes, base)
-            table = serialization.dumps_inline((offsets, sizes, meta))
-        return table, offsets, total
+        return segment_layout(meta, buffers)
 
     def _acquire_segment(self, object_id: ObjectID, total: int):
         """A writable mapping of >= ``total`` bytes: pooled if one fits
@@ -282,29 +289,13 @@ class ShmStore:
                         return new_name, mm, size
                     break  # sorted: everything later is even more wasteful
             if self._capacity:
-                # Pooled bytes are free memory: give them back before
-                # declaring the store full.  The cap applies to the whole
-                # NODE's usage (shared counter), not this process's.
-                node_used = self._node_used()
-                while node_used + total > self._capacity and self._pool:
-                    size, name, mm = self._pool.pop()
-                    self._pool_bytes -= size
-                    self._used -= size
-                    node_used = self._acct(-size)
-                    evict.append((name, mm))
+                node_used = self._evict_pool_until_fits_locked(total,
+                                                               evict)
                 if node_used + total > self._capacity:
                     raise MemoryError(
                         f"Object store over capacity: need {total}, "
                         f"node used {node_used}/{self._capacity}")
-        for name, mm in evict:
-            try:
-                mm.close()
-            except BufferError:
-                pass
-            try:
-                os.unlink(_segment_path(self._dir, name))
-            except OSError:
-                pass
+        self._close_evicted(evict)
         name = self.segment_name(object_id)
         path = _segment_path(self._dir, name)
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
@@ -314,6 +305,36 @@ class ShmStore:
         finally:
             os.close(fd)
         return name, mm, total
+
+    def _evict_pool_until_fits_locked(self, total: int,
+                                      evict: list) -> int:
+        """Pooled bytes are free memory: pop pool entries (appending
+        them to ``evict``) until ``total`` fits under the node cap or
+        the pool is empty; returns the final node usage.  The cap
+        applies to the whole NODE's usage (shared flock'd counter), not
+        this process's.  Caller holds ``self._lock`` and must pass
+        ``evict`` to ``_close_evicted`` AFTER releasing it.  One
+        implementation for every admission site (_acquire_segment,
+        reserve_put) — the shared-counter policy must not diverge."""
+        node_used = self._node_used()
+        while node_used + total > self._capacity and self._pool:
+            size, name, mm = self._pool.pop()
+            self._pool_bytes -= size
+            self._used -= size
+            node_used = self._acct(-size)
+            evict.append((name, mm))
+        return node_used
+
+    def _close_evicted(self, evict: list):
+        for name, mm in evict:
+            try:
+                mm.close()
+            except BufferError:
+                pass
+            try:
+                os.unlink(_segment_path(self._dir, name))
+            except OSError:
+                pass
 
     # ------------------------------------------------- zero-copy receive --
     # The cross-node puller's destination buffers (object_transfer.
@@ -374,6 +395,95 @@ class ShmStore:
             mm.close()
         except BufferError:
             pass
+
+    # --------------------------------------------------- direct-put ingest --
+    # The write-direction twin of reserve_recv: a remote pusher
+    # (object_transfer verbs reserve_put/put_range/commit_put) streams a
+    # value's byte-range stripes straight into a preallocated mapping —
+    # but unlike a received replica, the destination is a PUBLIC named
+    # segment other processes on this node will attach, so the file
+    # stays linked, the bytes are capacity-accounted up front (admission
+    # gates on the NODE counter, so concurrent pushers cannot overcommit
+    # tmpfs), and an over-capacity reservation degrades to the spill
+    # path (a disk-backed mapping under ``spill_dir``) instead of
+    # raising — the reference's plasma CreateObject fallback queue.
+
+    # Set by the embedding runtime/agent after construction; "" disables
+    # the spill degradation (over-capacity reservations then raise).
+    spill_dir: str = ""
+
+    def reserve_put(self, oid_bin: bytes, total: int) -> "PutReservation":
+        """A writable mapping for a pushed object, registered under the
+        object's canonical public segment name.  Pair with the
+        reservation's ``commit()`` (seal; file stays) or ``abort()``
+        (unlink + accounting rollback)."""
+        if total <= 0:
+            raise ValueError(f"cannot reserve {total}-byte put")
+        name = self.segment_name(ObjectID(oid_bin))
+        evict = []
+        over = False
+        newly_tracked = False
+        with self._lock:
+            if self._capacity:
+                node_used = self._evict_pool_until_fits_locked(total,
+                                                               evict)
+                over = node_used + total > self._capacity
+            if not over:
+                self._used += total
+                self._acct(total)
+                newly_tracked = name not in self._created
+                self._created.add(name)
+        self._close_evicted(evict)
+        if over:
+            if not self.spill_dir:
+                raise MemoryError(
+                    f"put reservation over store capacity: need {total} "
+                    f"(capacity {self._capacity}) and no spill_dir")
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, name)
+            mm = self._map_new_file(path, total)
+            return PutReservation(self, "spilled", name, path, total, mm)
+        path = _segment_path(self._dir, name)
+        try:
+            mm = self._map_new_file(path, total)
+        except BaseException:
+            with self._lock:
+                # Roll back only what THIS call added: on an EEXIST
+                # collision the _created entry belongs to the existing
+                # segment, not to us.
+                if newly_tracked:
+                    self._created.discard(name)
+                self._used -= total
+                self._acct(-total)
+            raise
+        return PutReservation(self, "shm", name, name, total, mm)
+
+    @staticmethod
+    def _map_new_file(path: str, total: int) -> mmap.mmap:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, total)
+            return mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+
+    def _finish_put(self, res: "PutReservation", commit: bool):
+        try:
+            res.mm.close()
+        except BufferError:
+            pass  # a straggling writer's view; the GC releases it
+        if commit:
+            return
+        try:
+            os.unlink(res.ident if res.kind == "spilled"
+                      else _segment_path(self._dir, res.name))
+        except OSError:
+            pass
+        if res.kind == "shm":
+            with self._lock:
+                self._created.discard(res.name)
+                self._used -= res.total
+                self._acct(-res.total)
 
     def attach(self, name: str) -> Segment:
         return self.attach_path(_segment_path(self._dir, name))
@@ -494,3 +604,38 @@ class ShmStore:
             except OSError:
                 pass
             self._acct_fd = None
+
+
+class PutReservation:
+    """One pending direct-put destination: a writable public mapping the
+    object server's ``put_range`` stripes recv straight into.
+
+    ``kind`` is ``"shm"`` (``ident`` == segment name) or ``"spilled"``
+    (``ident`` == absolute spill-file path — the over-capacity
+    degradation).  ``writers``/``dead`` belong to the object server's
+    put registry (guarded by ITS lock): concurrent stripe connections
+    ref-count in-flight writes so an abort never closes the mapping
+    under an active ``recv_bytes_into``."""
+
+    __slots__ = ("store", "kind", "name", "ident", "total", "mm",
+                 "writers", "dead")
+
+    def __init__(self, store: ShmStore, kind: str, name: str, ident: str,
+                 total: int, mm: mmap.mmap):
+        self.store = store
+        self.kind = kind
+        self.name = name
+        self.ident = ident
+        self.total = total
+        self.mm = mm
+        self.writers = 0
+        self.dead = False
+
+    def commit(self):
+        """Seal: close the writable mapping; the (linked, accounted)
+        file becomes attachable like any locally-created segment."""
+        self.store._finish_put(self, commit=True)
+
+    def abort(self):
+        """Tear down: close + unlink + restore store accounting."""
+        self.store._finish_put(self, commit=False)

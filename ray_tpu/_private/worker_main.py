@@ -97,6 +97,12 @@ class _WorkerRuntime:
         # the owner's directory, object_manager.h:206).
         self._puller = object_transfer.ObjectPuller(
             bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")))
+        # Write-direction twin: streams a put's payload straight into a
+        # remote store's object server (capability-gated; the client
+        # runtime's large puts to the head ride this).  Cheap to hold —
+        # pools dial lazily on first push.
+        self._pusher = object_transfer.ObjectPusher(
+            bytes.fromhex(os.environ.get("RAY_TPU_AUTHKEY", "")))
         # store_id -> (addr, caps) for stores with a reachable object
         # server; misses are never cached (a recovering peer gets its
         # fast path back on the next pull).
@@ -230,18 +236,30 @@ class _WorkerRuntime:
                 head_bins.append(b)
         return head_bins
 
+    def _drain_put_buffer(self) -> list:
+        """Buffered small-put messages that must precede any other
+        outgoing message (put -> addref -> later decref ordering).
+        Workers put owner-locally so the base buffer is always empty;
+        ClientRuntime overrides with its coalescing buffer."""
+        return []
+
     def _send(self, msg):
         head_bins = self._drain_decrefs()
         abuf = self._drain_actor_decrefs()
-        msgs = []
-        if head_bins:
-            msgs.append(("decref_batch", head_bins))
-        if abuf:
-            msgs.append(("actor_decref_batch", abuf))
-        msgs.append(msg)
         # One ("batch", ...) pickle + one write for the whole burst —
-        # buffered ref drops ride the same syscall as the payload.
+        # buffered ref drops ride the same syscall as the payload.  The
+        # put buffer is drained UNDER send_lock (drain is lock-append
+        # only, no I/O): draining earlier would open a window where a
+        # concurrent flusher's drained-but-unwritten puts let this
+        # message overtake a put it references.  Puts precede decrefs —
+        # a drop of a coalesced put's ref must never land first.
         with self.send_lock:
+            msgs = self._drain_put_buffer()
+            if head_bins:
+                msgs.append(("decref_batch", head_bins))
+            if abuf:
+                msgs.append(("actor_decref_batch", abuf))
+            msgs.append(msg)
             protocol.send_batch(self.conn, msgs)
 
     def send_result(self, entry):
@@ -302,14 +320,16 @@ class _WorkerRuntime:
     def flush_decrefs(self):
         head_bins = self._drain_decrefs()
         abuf = self._drain_actor_decrefs()
-        if not head_bins and not abuf:
-            return
-        msgs = []
-        if head_bins:
-            msgs.append(("decref_batch", head_bins))
-        if abuf:
-            msgs.append(("actor_decref_batch", abuf))
         with self.send_lock:
+            # Put drain under send_lock (see _send); puts precede their
+            # refs' decrefs in the envelope.
+            msgs = self._drain_put_buffer()
+            if not msgs and not head_bins and not abuf:
+                return
+            if head_bins:
+                msgs.append(("decref_batch", head_bins))
+            if abuf:
+                msgs.append(("actor_decref_batch", abuf))
             protocol.send_batch(self.conn, msgs)
 
     # Actor-handle refcounts (reference: actor out-of-scope GC) — the head

@@ -203,6 +203,15 @@ def test_failed_leader_wakes_waiters_to_fallback(make_rt):
     caller's existing fallback path) and the registry holds no stuck
     entries."""
     rt = make_rt("tcp://127.0.0.1:1")  # nothing listens here
+    # The failed pull deliberately FORGETS the cached store address
+    # (restarted peers re-resolve), so a thread arriving after the
+    # leader's entry is popped becomes a new leader and re-asks the
+    # HEAD for the address.  This fixture's head conn is inert — answer
+    # the store_addr lookup with "no server" (None) instead of letting
+    # the late leader block forever on a reply that never comes (the
+    # real head always replies; a scheduling-dependent hang here made
+    # the test flaky in-suite).
+    rt._request = lambda build: None
     descr = ("shm", "rtpu-pfpeer-missing", 1 << 20, PEER)
     results = []
     barrier = threading.Barrier(4)

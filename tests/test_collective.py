@@ -184,6 +184,9 @@ def test_ring_mean(ray8):
     assert all(abs(o - 2.0) < 1e-5 for o in outs)
 
 
+@pytest.mark.slow  # ~6s perf A/B; ring CORRECTNESS keeps its tier-1
+# coverage via the sub-second ring_allreduce/allgather/reducescatter/
+# mean tests above — this row only re-measures the speedup.
 def test_ring_beats_star_bench(ray8):
     """VERDICT #4 'done': big allreduce through the ring vs the star.
     On multi-core hardware the ring wins >2x (every link busy vs one

@@ -323,6 +323,55 @@ def test_pull_registry_lock_order_convention(checker):
     checker.assert_acyclic()
 
 
+def test_put_registry_lock_order_convention(checker, tmp_path):
+    """object_transfer.PutRegistry's documented convention: the
+    server-side put-registry ``_lock`` is an INDEPENDENT LEAF — it
+    guards only the entry table and writer counts; reservation (file
+    create + store accounting), stripe recv streaming, and mapping
+    teardown all run OUTSIDE it.  The recorded acquisition graph must
+    show zero outgoing edges from it across the reserve/write/commit/
+    abort/dead-writer paths.  (The store's own ``_lock``, taken inside
+    reserve_put, is a separate class acquired while the registry lock is
+    NOT held.)"""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_transfer import CHUNK, PutRegistry
+    from ray_tpu._private.shm_store import ShmStore
+
+    class _FeedConn:
+        """recv_bytes_into stub: fills the requested range with zeros,
+        one CHUNK-sized message at a time."""
+
+        def __init__(self):
+            self.left = 0
+
+        def recv_bytes_into(self, view, off=0):
+            n = min(CHUNK, len(view) - off)
+            view[off:off + n] = b"\0" * n
+            return n
+
+    store = ShmStore(shm_dir=str(tmp_path), session_id="putlock")
+    reg = PutRegistry(store)
+    assert isinstance(reg._lock, lockcheck._LockProxy)
+    # Reserve -> stripe write -> commit.
+    name = reg.reserve(ObjectID.from_random().binary(), 4096)
+    assert reg.write(name, _FeedConn(), 0, 4096)
+    kind, ident, total = reg.commit(name)
+    assert (kind, ident, total) == ("shm", name, 4096)
+    # Reserve -> abort; a late stripe for the aborted put drains via the
+    # discard path (needs recv_bytes, absent on the stub -> use a fresh
+    # name with zero length instead: the bounds check refuses in-lock).
+    name2 = reg.reserve(ObjectID.from_random().binary(), 4096)
+    reg.abort(name2)
+    assert not reg.write(name2, _FeedConn(), 0, 0)
+    reg_site = reg._lock._site
+    edges = checker.edges()
+    assert edges.get(reg_site, set()) == set(), (
+        f"a lock was acquired while holding the put-registry lock: "
+        f"{edges.get(reg_site)}")
+    checker.assert_acyclic()
+    store.cleanup()
+
+
 def test_streaming_stats_lock_convention(checker):
     """data/streaming_executor.StreamingStats._lock's documented
     convention: an independent LEAF — the executor's dispatch loop is

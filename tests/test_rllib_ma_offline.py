@@ -72,6 +72,10 @@ class TwoAgentMatch(MultiAgentEnv):
         return obs, rews, terms, truncs, {}
 
 
+@pytest.mark.slow  # ~8s of PPO convergence; the "X learns" battery is
+# slow-tier by convention (test_rllib.py) — multi-agent ROLLOUT
+# mechanics keep sub-second tier-1 coverage via the turn-based reward
+# tests below, and PPO wiring via test_rllib's checkpoint roundtrip.
 def test_two_policy_ppo_learns(cluster):
     cfg = (PPOConfig()
            .environment(TwoAgentMatch)
