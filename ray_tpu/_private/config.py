@@ -160,6 +160,23 @@ class Config:
     # (reference: hybrid policy spillback).  0 disables spillback.
     lease_spillback_depth: int = 32
 
+    # --- Serving (ray_tpu.serve; reference: Orca OSDI'22 iteration-level
+    # scheduling + serve autoscaling_policy.py). ---
+    # Master switch for the continuous-batching engine behind
+    # @serve.batch(mode="continuous"): on, queued requests are admitted
+    # into the RUNNING batch at step boundaries and finished requests'
+    # slots refill the same step.  Off = the same step function driven
+    # one-shot (fixed batch admitted only when the previous one fully
+    # finished — the legacy window semantics), the measured A/B
+    # baseline.  Read in the REPLICA process (rides _worker_config_env).
+    continuous_batching: bool = True
+    # Autoscale smoothing: the controller scales on each handle's PEAK
+    # ongoing-request count inside this look-back window.
+    serve_metric_lookback_s: float = 3.0
+    # Default quiet period before a deployment downscales (an explicit
+    # autoscaling_config downscale_delay_s overrides it per deployment).
+    serve_downscale_delay_s: float = 5.0
+
     # Seconds a worker may sit idle before the pool reaps it (reference:
     # idle worker killing in worker_pool.cc).
     idle_worker_timeout_s: float = 300.0

@@ -2113,6 +2113,16 @@ class Runtime:
                 str(self.config.lease_renew_tasks),
             "RAY_TPU_LEASE_SPILLBACK_DEPTH":
                 str(self.config.lease_spillback_depth),
+            # Serving knobs: the continuous-batching switch is read in
+            # the REPLICA worker, the autoscale windows in the
+            # controller worker — both only see _system_config through
+            # this env namespace.
+            "RAY_TPU_CONTINUOUS_BATCHING":
+                "1" if self.config.continuous_batching else "0",
+            "RAY_TPU_SERVE_METRIC_LOOKBACK_S":
+                str(self.config.serve_metric_lookback_s),
+            "RAY_TPU_SERVE_DOWNSCALE_DELAY_S":
+                str(self.config.serve_downscale_delay_s),
         }
 
     def _spawn_worker(self, node: NodeState, env_key: str,

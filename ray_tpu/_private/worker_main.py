@@ -612,6 +612,28 @@ class _WorkerRuntime:
         self._tls.ref_collector = None
         return out
 
+    def _notify_blocked(self) -> bool:
+        """Whether blocking in get/wait should send the head the
+        blocked/unblocked envelope.  The envelope lets the head release
+        this worker's lease slot and — crucially for plain task workers
+        — excludes it from pipelined dispatch while it waits
+        (``w.blocked`` in the pipelinable-worker scan), so PLAIN tasks
+        always send it regardless of resources: suppressing it for a
+        0-CPU task could queue its own dependency behind its blocked
+        get.  ACTOR workers are never pipelined-to (``w.actor_id``
+        exclusion) and a client runtime holds no lease at all, so for a
+        zero-resource actor (num_cpus=0 normalizes to {"CPU": 0.0} —
+        the serve RequestProxy shape, blocking once per routed request)
+        and for clients the pair is two head messages per get of pure
+        hot-path chatter and is skipped.  Empty/unknown resources keep
+        the envelope."""
+        if getattr(self, "is_client", False):
+            return False
+        if self.current_actor_id is None:
+            return True
+        res = self.assigned_resources
+        return not res or any(res.values())
+
     def get_objects(self, refs, timeout=None):
         """Batched get: owned refs resolve against the local ownership
         table (zero head traffic — the caller IS the metadata authority,
@@ -635,7 +657,23 @@ class _WorkerRuntime:
 
         deadline = None if timeout is None else _time.monotonic() + timeout
         tid = self.current_task_id
-        self._send(("blocked", tid.binary() if tid else b""))
+        # Suppression applies only to purely-OWNED gets (the proxy hot
+        # path): before any head fetch — initial misses OR refs that
+        # become delegated mid-get — _upgrade_notify below sends the
+        # envelope, because the head may need this worker's blocked
+        # credit (lend slots) to make the dependency runnable at all on
+        # a saturated node.  Clients stay suppressed throughout — they
+        # register outside the node worker tables, so their flag feeds
+        # nothing.
+        notify = self._notify_blocked()
+        if notify:
+            self._send(("blocked", tid.binary() if tid else b""))
+
+        def _upgrade_notify():
+            nonlocal notify
+            if not notify and not getattr(self, "is_client", False):
+                notify = True
+                self._send(("blocked", tid.binary() if tid else b""))
         try:
             if owned:
                 done = self.direct.wait_owned([o for _, o in owned],
@@ -658,6 +696,7 @@ class _WorkerRuntime:
                         st.attached = True
                     self._cache_put(oid, values[i])
             if missing:
+                _upgrade_notify()
                 left = (None if deadline is None
                         else max(0.0, deadline - _time.monotonic()))
                 reply = self._request(
@@ -669,7 +708,8 @@ class _WorkerRuntime:
                         raise self.materialize_error(descr)
                     values[i] = self.materialize(descr)
         finally:
-            self._send(("unblocked", tid.binary() if tid else b""))
+            if notify:
+                self._send(("unblocked", tid.binary() if tid else b""))
         return values
 
     def materialize_error(self, descr):
@@ -821,12 +861,28 @@ class _WorkerRuntime:
 
         deadline = None if timeout is None else _time.monotonic() + timeout
         tid = self.current_task_id
-        self._send(("blocked", tid.binary() if tid else b""))
+        # As in get_objects: suppression only for purely-owned waits —
+        # foreign (head-routed) refs, whether present up front or
+        # appearing mid-wait via delegation, upgrade to the envelope
+        # before any head RPC (the blocked credit feeds the head's
+        # lend/steal paths).
+        notify = self._notify_blocked()
+        if notify:
+            self._send(("blocked", tid.binary() if tid else b""))
+
+        def _upgrade_notify():
+            nonlocal notify
+            if not notify and not getattr(self, "is_client", False):
+                notify = True
+                self._send(("blocked", tid.binary() if tid else b""))
+
         try:
             while True:
                 left = (None if deadline is None
                         else max(0.0, deadline - _time.monotonic()))
                 owned, foreign = self.direct.split_refs(refs)
+                if foreign:
+                    _upgrade_notify()
                 if not foreign:
                     ready, delegated = self.direct.wait_owned_n(
                         [r.id() for r in owned], num_returns, left)
@@ -860,7 +916,8 @@ class _WorkerRuntime:
                 with self.direct.cv:
                     self.direct.cv.wait(0.05)
         finally:
-            self._send(("unblocked", tid.binary() if tid else b""))
+            if notify:
+                self._send(("unblocked", tid.binary() if tid else b""))
         ready = [r for r in refs if r.id().binary() in ready_bin]
         not_ready = [r for r in refs if r.id().binary() not in ready_bin]
         return ready, not_ready
@@ -1435,8 +1492,14 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                           [(protocol.ERROR, _pickle_error(err))], {}))
         elif tag == "exec":
             task = msg[1]
-            rt.assigned_resources = task.get("resources",
-                                             rt.assigned_resources)
+            if "actor_id" not in task:
+                # Actor-method execs keep the CREATION resources: the
+                # actor's worker holds those for its lifetime, and the
+                # head's per-method record defaults to {"CPU": 1} even
+                # for a 0-CPU actor (which would wrongly re-enable the
+                # blocked envelope on the serve proxy hot path).
+                rt.assigned_resources = task.get("resources",
+                                                 rt.assigned_resources)
             if pool is not None and "actor_id" in task:
                 pool.submit(_execute, rt, fns, task, actors)
             else:

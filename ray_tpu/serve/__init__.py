@@ -17,15 +17,20 @@ from ray_tpu.serve.api import (
     Deployment,
     DeploymentHandle,
     HTTPProxyActor,
+    ProxiedDeploymentHandle,
+    RequestProxy,
     deployment,
     get_deployment_handle,
     run,
+    serving_stats,
     shutdown,
     start,
     start_http_proxy,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.continuous import Slot
 
 __all__ = ["deployment", "Deployment", "DeploymentHandle", "run",
            "get_deployment_handle", "shutdown", "start",
-           "start_http_proxy", "HTTPProxyActor", "batch"]
+           "start_http_proxy", "HTTPProxyActor", "RequestProxy",
+           "ProxiedDeploymentHandle", "serving_stats", "batch", "Slot"]

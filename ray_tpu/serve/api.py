@@ -42,6 +42,22 @@ class ReplicaWrapper:
             self._callable.check_health()
         return True
 
+    def serving_stats(self):
+        """Batching observability: one stats dict per batcher attached
+        to the user callable (legacy one-shot and continuous engines
+        share the shape — steps/batch_occupancy/queued/admitted/
+        retired), aggregated per deployment by the controller."""
+        from ray_tpu.serve.batching import _Batcher
+        from ray_tpu.serve.continuous import _ContinuousBatcher
+
+        out = []
+        holder = self._callable
+        for v in list(vars(holder).values()) if hasattr(holder, "__dict__") \
+                else []:
+            if isinstance(v, (_Batcher, _ContinuousBatcher)):
+                out.append(v.stats())
+        return out
+
 
 @ray.remote
 class ServeController:
@@ -57,6 +73,14 @@ class ServeController:
     METRIC_LOOK_BACK_S = 3.0
 
     def __init__(self):
+        # Autoscale smoothing window: overridable via _system_config /
+        # RAY_TPU_SERVE_METRIC_LOOKBACK_S (the controller runs in a
+        # worker, so the knob rides _worker_config_env).
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self.METRIC_LOOK_BACK_S = GLOBAL_CONFIG.serve_metric_lookback_s
+        self._default_downscale_delay_s = \
+            GLOBAL_CONFIG.serve_downscale_delay_s
         self._deployments: Dict[str, Dict[str, Any]] = {}
         # name -> list of {"actor": handle, "version": int}
         self._replicas: Dict[str, List[Dict[str, Any]]] = {}
@@ -65,14 +89,25 @@ class ServeController:
         # proxy's route table long-polled from the controller,
         # _private/http_proxy.py + long_poll.py ROUTE_TABLE key).
         self._routes: Dict[str, str] = {}
-        # autoscaling inputs: (name, handle_id) -> recent (ongoing, ts)
-        # samples.  A short look-back window, not just the last sample:
-        # instantaneous queue depth oscillates with sampling phase (scale
-        # up -> queue drains faster -> next sample reads low -> scale
-        # back down), so decisions smooth over METRIC_LOOK_BACK_S
-        # (reference: look_back_period_s in autoscaling_policy.py).
+        # autoscaling inputs: (name, incarnation, handle_id) -> recent
+        # (ongoing, ts) samples.  A short look-back window, not just the
+        # last sample: instantaneous queue depth oscillates with
+        # sampling phase (scale up -> queue drains faster -> next sample
+        # reads low -> scale back down), so decisions smooth over
+        # METRIC_LOOK_BACK_S (reference: look_back_period_s in
+        # autoscaling_policy.py).  Keyed by the deployment INCARNATION
+        # (bumped when a name is deleted and redeployed) so a stale
+        # handle from a deleted deployment can never feed the fresh
+        # deployment's autoscaler (its samples are dropped at record
+        # time).
         self._handle_metrics: Dict[tuple, deque] = {}
+        # name -> deploy generation; delete+redeploy under one name
+        # yields a new incarnation.
+        self._incarnations: Dict[str, int] = {}
         self._last_scale_up: Dict[str, float] = {}
+        # Autoscaling observability: name -> [scale_up_events,
+        # scale_down_events] (surfaced via serving_stats()).
+        self._scale_events: Dict[str, List[int]] = {}
         # Retired replicas draining before the actual kill: handles stop
         # routing to them immediately (they leave get_replicas), but the
         # process lives past the handle-refresh TTL so in-flight requests
@@ -129,6 +164,14 @@ class ServeController:
                 return True  # idempotent redeploy: no rolling restart
             version = (prev["version"] + 1) if prev is not None else 1
             payload["version"] = version
+            if prev is None and name not in self._incarnations:
+                # First-ever deploy of this name.  (A redeploy after a
+                # delete keeps the incarnation delete_deployment already
+                # bumped — bumping at DELETE time, not redeploy time,
+                # also invalidates still-live handles' reports during
+                # the deleted window, so they cannot repopulate the
+                # purged metric map.)
+                self._incarnations[name] = 1
             self._deployments[name] = payload
         # Reconcile outside _lock: the tick takes _reconcile_lock then
         # _lock — holding _lock here would invert the order vs the
@@ -139,6 +182,20 @@ class ServeController:
     def delete_deployment(self, name: str):
         with self._lock:
             self._deployments.pop(name, None)
+            # Drop the dead incarnation's autoscale state wholesale —
+            # metric windows, scale counters, last-scale-up stamp — so
+            # the next same-name deploy starts with a clean slate (a
+            # stale _last_scale_up would gate the fresh deployment's
+            # first downscale against the DEAD deployment's history).
+            for key in [k for k in self._handle_metrics if k[0] == name]:
+                self._handle_metrics.pop(key, None)
+            self._scale_events.pop(name, None)
+            self._last_scale_up.pop(name, None)
+            # Bump NOW (not at redeploy): surviving handles' reports go
+            # stale immediately and record_handle_metric drops them, so
+            # the purge above cannot be undone by a live handle still
+            # reporting between the delete and a redeploy.
+            self._incarnations[name] = self._incarnations.get(name, 0) + 1
             reps = self._replicas.pop(name, [])
             # Routes to a deleted deployment 404 (proxies refresh the
             # table within their TTL) instead of erroring forever.
@@ -158,18 +215,60 @@ class ServeController:
             self._replica_version.get(name, 0) + 1
         self._version_cv.notify_all()
 
-    def record_handle_metric(self, name: str, handle_id: str, ongoing: int):
+    def record_handle_metric(self, name: str, handle_id: str,
+                             ongoing: int,
+                             incarnation: Optional[int] = None):
         """Handles report their in-flight request count — the autoscaling
         signal (reference: handle-side metrics pushed to the controller,
-        _private/router.py + autoscaling_policy.py)."""
+        _private/router.py + autoscaling_policy.py).  Samples are keyed
+        by (name, incarnation, handle_id); a report carrying a stale
+        incarnation (the handle predates a delete+redeploy of this name)
+        is DROPPED — it describes requests against replicas that no
+        longer exist and must not scale the fresh deployment."""
         now = time.monotonic()
         with self._lock:
-            q = self._handle_metrics.get((name, handle_id))
+            cur = self._incarnations.get(name, 0)
+            if incarnation is None:
+                incarnation = cur  # legacy caller: assume current
+            if incarnation != cur:
+                return False
+            q = self._handle_metrics.get((name, incarnation, handle_id))
             if q is None:
-                q = self._handle_metrics[(name, handle_id)] = \
-                    deque(maxlen=32)
+                q = self._handle_metrics[
+                    (name, incarnation, handle_id)] = deque(maxlen=32)
             q.append((ongoing, now))
         return True
+
+    def deployment_incarnation(self, name: str) -> int:
+        with self._lock:
+            return self._incarnations.get(name, 0)
+
+    def handle_snapshot(self, name: str):
+        """One-RPC handle bootstrap: (replica_version, replicas,
+        incarnation)."""
+        with self._lock:
+            return (self._replica_version.get(name, 0),
+                    [r["actor"] for r in self._replicas.get(name, [])],
+                    self._incarnations.get(name, 0))
+
+    def _ongoing_locked(self, name: str, now: float) -> int:
+        """Summed per-handle PEAK ongoing inside the look-back window —
+        robust to sampling phase while load is sustained; an idle
+        handle's samples age out and read 0 (downscale_delay then gates
+        the shrink).  Only the CURRENT incarnation's windows count
+        (record_handle_metric drops stale reports; windows recorded
+        before a delete were purged there).  The single source for both
+        the autoscaler and serving_stats()."""
+        inc = self._incarnations.get(name, 0)
+        ongoing = 0
+        for (n, i, _h), samples in self._handle_metrics.items():
+            if n != name or i != inc:
+                continue
+            fresh = [v for v, ts in samples
+                     if now - ts < self.METRIC_LOOK_BACK_S]
+            if fresh:
+                ongoing += max(fresh)
+        return ongoing
 
     def _spawn(self, d: Dict[str, Any], version: int):
         # Threaded replicas: concurrent requests are what @serve.batch
@@ -191,18 +290,7 @@ class ServeController:
             return d.get("num_replicas", 1)
         now = time.monotonic()
         with self._lock:
-            # Per handle: the PEAK ongoing inside the look-back window —
-            # robust to sampling phase while load is sustained; an idle
-            # handle's samples age out and read 0 (downscale_delay then
-            # gates the shrink).
-            ongoing = 0
-            for (n, _h), samples in self._handle_metrics.items():
-                if n != name:
-                    continue
-                fresh = [v for v, ts in samples
-                         if now - ts < self.METRIC_LOOK_BACK_S]
-                if fresh:
-                    ongoing += max(fresh)
+            ongoing = self._ongoing_locked(name, now)
         target_per = max(cfg.get("target_ongoing_requests", 1), 1e-9)
         import math
 
@@ -211,14 +299,24 @@ class ServeController:
                       min(cfg.get("max_replicas", 1), desired))
         cur = len(self._replicas.get(name, []))
         if desired > cur:
-            self._last_scale_up[name] = now
+            with self._lock:
+                # Deleted mid-tick: don't repopulate the state the
+                # delete-time purge just cleared (a same-name redeploy
+                # would inherit the dead deployment's scale-up stamp).
+                if name in self._deployments:
+                    self._last_scale_up[name] = now
+                    self._scale_events.setdefault(name, [0, 0])[0] += 1
             return desired
         if desired < cur:
             # Downscale only after a quiet period (reference:
             # downscale_delay_s in autoscaling_policy.py).
-            delay = cfg.get("downscale_delay_s", 5.0)
+            delay = cfg.get("downscale_delay_s",
+                            self._default_downscale_delay_s)
             if now - self._last_scale_up.get(name, 0.0) < delay:
                 return cur
+            with self._lock:
+                if name in self._deployments:
+                    self._scale_events.setdefault(name, [0, 0])[1] += 1
         return desired
 
     def reconcile(self):
@@ -308,9 +406,12 @@ class ServeController:
     def wait_replicas(self, name: str, seen_version: int,
                       timeout: float = 30.0):
         """Long-poll: block until the replica set changes past
-        ``seen_version`` (or timeout), then return the fresh set
-        (reference: LongPollHost.listen_for_change,
-        _private/long_poll.py:185)."""
+        ``seen_version`` (or timeout), then return (version, replicas,
+        incarnation) (reference: LongPollHost.listen_for_change,
+        _private/long_poll.py:185).  The incarnation rides along so a
+        handle surviving a delete+redeploy of its name re-keys its
+        metric reports instead of feeding the controller stale-keyed
+        samples forever."""
         deadline = time.monotonic() + timeout
         with self._version_cv:
             while self._replica_version.get(name, 0) <= seen_version:
@@ -319,7 +420,8 @@ class ServeController:
                     break
                 self._version_cv.wait(left)
             return (self._replica_version.get(name, 0),
-                    [r["actor"] for r in self._replicas.get(name, [])])
+                    [r["actor"] for r in self._replicas.get(name, [])],
+                    self._incarnations.get(name, 0))
 
     def num_replicas(self, name: str) -> int:
         with self._lock:
@@ -331,6 +433,65 @@ class ServeController:
                         "version": d.get("version", 1),
                         "autoscaling": bool(d.get("autoscaling_config"))}
                     for n, d in self._deployments.items()}
+
+    def serving_stats(self, name: Optional[str] = None):
+        """Per-deployment serving observability (the transfer_stats()
+        analog for the serve plane): queued/ongoing request counts,
+        batch occupancy and step totals aggregated over the replicas'
+        batchers, plus the autoscale scale-up/scale-down event pair."""
+        now = time.monotonic()
+        with self._lock:
+            names = [name] if name is not None else list(self._deployments)
+            snap = {}
+            for n in names:
+                ups, downs = self._scale_events.get(n, [0, 0])
+                snap[n] = {
+                    "replicas": [r["actor"]
+                                 for r in self._replicas.get(n, [])],
+                    "ongoing": self._ongoing_locked(n, now),
+                    "scale_ups": ups,
+                    "scale_downs": downs,
+                }
+        out = {}
+        for n, s in snap.items():
+            reps = s.pop("replicas")
+            agg = {"replicas": len(reps), "queued": 0, "steps": 0,
+                   "admitted": 0, "retired": 0, "step_errors": 0,
+                   "batch_occupancy": 0.0, **s}
+            occ_steps = 0.0
+            modes = set()
+            # Replica RPCs run OUTSIDE _lock (a saturated replica must
+            # not wedge the controller) and are issued in PARALLEL with
+            # one shared deadline — N unreachable replicas cost one 5s
+            # wait, not N; whoever cannot answer in time is skipped and
+            # the aggregate stays partial-but-live.
+            refs = []
+            for r in reps:
+                try:
+                    refs.append(r.serving_stats.remote())
+                except Exception:
+                    pass
+            done = ray.wait(refs, num_returns=len(refs),
+                            timeout=5)[0] if refs else []
+            for ref in done:
+                try:
+                    rows = ray.get(ref, timeout=1)
+                except Exception:
+                    continue
+                for b in rows:
+                    agg["queued"] += b["queued"]
+                    agg["steps"] += b["steps"]
+                    agg["admitted"] += b["admitted"]
+                    agg["retired"] += b["retired"]
+                    agg["step_errors"] += b["step_errors"]
+                    occ_steps += b["batch_occupancy"] * b["steps"]
+                    modes.add(b["mode"])
+            if modes:
+                agg["mode"] = modes.pop() if len(modes) == 1 else "mixed"
+            if agg["steps"]:
+                agg["batch_occupancy"] = round(occ_steps / agg["steps"], 3)
+            out[n] = agg
+        return out if name is None else out.get(name, {})
 
     def set_route(self, prefix: str, name: str):
         with self._lock:
@@ -352,7 +513,140 @@ class ServeController:
         return True
 
 
-class DeploymentHandle:
+class _P2CRouterBase:
+    """Shared power-of-two-choices routing state (used by replica
+    handles AND proxy handles): live in-flight counts per target,
+    incremented at dispatch, decremented by an idempotent weakref
+    finalizer when the caller drops the result ref.  Subclasses own
+    ``self._lock`` acquisition around the ``_locked`` helpers."""
+
+    def _router_init(self):
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, int] = {}  # target key -> live count
+        # Result-ref ids currently counted in _inflight: finalizers
+        # decrement only while their ref is still counted, so a ref an
+        # external reconcile already pruned cannot decrement twice and
+        # erase another request's count.
+        self._counted: Dict[bytes, int] = {}  # ref id -> target key
+        # (weakref(result_ref), target key) per dispatched request: the
+        # periodic ground-truth reconcile — finalizers only fire when
+        # the caller DROPS a ref, so a completed-but-held ref would
+        # otherwise read as in-flight forever and skew routing.
+        self._outstanding: List[tuple] = []
+        self._last_reconcile = 0.0
+        self._ongoing = 0  # last reconcile's pending-request count
+        # Dropped-ref ids queued by the (LOCK-FREE) finalizer, drained
+        # under _lock on the next pick/dispatch: CPython runs finalizers
+        # synchronously at deallocation, which can happen in a frame
+        # that already holds _lock (the reconcile's temporaries can be
+        # the last strong reference) — taking the non-reentrant lock
+        # there would self-deadlock the router.
+        self._dead_refs: List[bytes] = []
+
+    def _pick_two_locked(self, reps: List[Any]):
+        """Two DISTINCT candidates (round-robin first — idle routers
+        keep alternating — a random draw second), route to the
+        less-loaded one, ties to the round-robin choice."""
+        import random
+
+        self._drain_dead_locked()
+        i = next(self._rr) % len(reps)
+        j = random.randrange(len(reps))
+        if j == i:
+            j = (j + 1) % len(reps)
+        a, b = reps[i], reps[j]
+        if self._inflight.get(id(b), 0) < self._inflight.get(id(a), 0):
+            return b
+        return a
+
+    def _dec_inflight(self, idbin: bytes):
+        """Weakref finalizer for a result ref: the caller consumed (and
+        dropped) the result — no longer in flight on its target.
+        LOCK-FREE (list.append is GIL-atomic): see _dead_refs."""
+        self._dead_refs.append(idbin)
+
+    def _drain_dead_locked(self):
+        """Apply queued finalizer decrements.  Runs at every pick and
+        dispatch, under _lock."""
+        while True:
+            try:
+                idbin = self._dead_refs.pop()
+            except IndexError:
+                return
+            rkey = self._counted.pop(idbin, None)
+            if rkey is None:
+                continue
+            c = self._inflight.get(rkey, 0)
+            if c <= 1:
+                self._inflight.pop(rkey, None)
+            else:
+                self._inflight[rkey] = c - 1
+
+    def _count_dispatch_locked(self, idbin: bytes, rkey: int):
+        self._drain_dead_locked()
+        self._inflight[rkey] = self._inflight.get(rkey, 0) + 1
+        self._counted[idbin] = rkey
+
+    # How often dispatch triggers the ground-truth reconcile (also the
+    # handle's controller-metric cadence).
+    _RECONCILE_PERIOD = 0.5
+
+    def _finalize_on_drop(self, ref):
+        import weakref
+
+        weakref.finalize(ref, self._dec_inflight, ref.id().binary())
+
+    def _note_dispatch(self, ref, target) -> bool:
+        """Register one dispatched request: weak-track the result ref
+        (the router must never pin results), bump the target's live
+        count, arm the drop finalizer; every _RECONCILE_PERIOD also run
+        the ground-truth reconcile (ongoing count left in
+        ``self._ongoing``).  Returns True when the reconcile ran."""
+        import weakref
+
+        now = time.monotonic()
+        with self._lock:
+            self._outstanding.append((weakref.ref(ref), id(target)))
+            self._count_dispatch_locked(ref.id().binary(), id(target))
+            ran = now - self._last_reconcile >= self._RECONCILE_PERIOD
+            if ran:
+                self._last_reconcile = now
+                self._ongoing = self._reconcile_outstanding_locked()
+        self._finalize_on_drop(ref)
+        return ran
+
+    def _reconcile_outstanding_locked(self) -> int:
+        """Ground-truth prune: drop completed/collected refs from the
+        outstanding list and rebuild the in-flight counts AND the
+        counted-ref map from the actually-pending refs (keeping the
+        finalizers idempotent).  Returns the ongoing request count."""
+        live = [(w(), k) for w, k in self._outstanding]
+        live = [(r, k) for r, k in live if r is not None]
+        if live:
+            import ray_tpu as _ray
+
+            done, pending = _ray.wait(
+                [r for r, _ in live], num_returns=len(live), timeout=0)
+            pend_set = {r.id() for r in pending}
+            self._outstanding = [
+                (w, k) for w, k in self._outstanding
+                if (r := w()) is not None and r.id() in pend_set]
+        else:
+            self._outstanding = []
+        counts: Dict[int, int] = {}
+        counted: Dict[bytes, int] = {}
+        for w, k in self._outstanding:
+            counts[k] = counts.get(k, 0) + 1
+            r = w()
+            if r is not None:
+                counted[r.id().binary()] = k
+        self._inflight = counts
+        self._counted = counted
+        return len(self._outstanding)
+
+
+class DeploymentHandle(_P2CRouterBase):
     """Router over replicas (reference: _private/router.py:262
     ReplicaSet / handle API).
 
@@ -361,11 +655,13 @@ class DeploymentHandle:
     (reference: LongPollClient, _private/long_poll.py:68), so a
     downscaled/drained replica stops receiving traffic the moment the
     controller retires it — no TTL window.  Routing is least-loaded
-    power-of-two-choices over the handle's in-flight counts (reference:
-    the queue-length-aware replica scheduler in _private/router.py).
+    power-of-two-choices on LIVE per-replica ongoing-request counts —
+    the same metric the handle reports to the controller's autoscaler —
+    incremented at dispatch and decremented when the caller's result
+    ref dies (weakref finalizer), with the periodic ray.wait prune as
+    the ground-truth reconciler (reference: the queue-length-aware
+    replica scheduler in _private/router.py).
     """
-
-    _METRIC_PERIOD = 0.5
 
     def __init__(self, name: str, controller):
         import os
@@ -374,18 +670,14 @@ class DeploymentHandle:
         self._controller = controller
         self._replicas: List[Any] = []
         self._version = -1
-        self._rr = itertools.count()
-        self._lock = threading.Lock()
-        # Autoscaling signal: outstanding request refs this handle issued;
-        # pruned on each call and reported to the controller (reference:
-        # handle-side num_queued/ongoing metrics feeding
-        # autoscaling_policy.py).  Entries are (weakref, replica_key) so
-        # the same prune also yields per-replica queue depths for
-        # least-loaded routing.
+        self._incarnation = 0
+        self._router_init()
+        # Autoscaling signal: the router's outstanding-ref prune also
+        # yields the ongoing count reported to the controller
+        # (reference: handle-side num_queued/ongoing metrics feeding
+        # autoscaling_policy.py).
         self._handle_id = os.urandom(4).hex()
-        self._outstanding: List[tuple] = []
-        self._inflight: Dict[int, int] = {}  # replica key -> est. depth
-        self._last_report = 0.0
+        self._closed = False
         self._refresh()
         self._poller = threading.Thread(
             target=self._long_poll_loop, daemon=True,
@@ -393,16 +685,17 @@ class DeploymentHandle:
         self._poller.start()
 
     def _refresh(self):
-        ver, reps = ray.get(
-            self._controller.get_replicas_versioned.remote(self._name))
+        ver, reps, inc = ray.get(
+            self._controller.handle_snapshot.remote(self._name))
         with self._lock:
             self._version = ver
             self._replicas = reps
+            self._incarnation = inc
 
     def _long_poll_loop(self):
-        while True:
+        while not self._closed:
             try:
-                ver, reps = ray.get(
+                ver, reps, inc = ray.get(
                     self._controller.wait_replicas.remote(
                         self._name, self._version, 30.0),
                     timeout=40.0)
@@ -413,10 +706,15 @@ class DeploymentHandle:
                 if ver > self._version:
                     self._version = ver
                     self._replicas = reps
+                    self._incarnation = inc
+
+    def close(self):
+        """Stop the long-poll thread (handles replaced by
+        get_deployment_handle's stale-swap would otherwise leak a
+        poller holding a standing controller RPC forever)."""
+        self._closed = True
 
     def _pick(self):
-        import random
-
         with self._lock:
             if not self._replicas:
                 pass  # fall through to the blocking refresh below
@@ -424,15 +722,10 @@ class DeploymentHandle:
                 reps = self._replicas
                 if len(reps) == 1:
                     return reps[0]
-                # Power-of-two-choices on estimated queue depth; round-
-                # robin supplies the randomness floor.
-                i = next(self._rr) % len(reps)
-                j = random.randrange(len(reps))
-                a, b = reps[i], reps[j]
-                if self._inflight.get(id(b), 0) < \
-                        self._inflight.get(id(a), 0):
-                    return b
-                return a
+                # Power-of-two-choices on the live ongoing-request
+                # counts — the same metric this handle reports to the
+                # controller's autoscaler.
+                return self._pick_two_locked(reps)
         self._refresh()
         with self._lock:
             if not self._replicas:
@@ -441,41 +734,14 @@ class DeploymentHandle:
             return self._replicas[next(self._rr) % len(self._replicas)]
 
     def _track(self, ref, replica):
-        import weakref
-
-        rkey = id(replica)
-        now = time.monotonic()
-        with self._lock:
-            # Weak refs: the handle must never pin result objects — an
-            # idle handle after a burst would otherwise hold the last
-            # batch's outputs alive in the object store forever.
-            self._outstanding.append((weakref.ref(ref), rkey))
-            self._inflight[rkey] = self._inflight.get(rkey, 0) + 1
-            if now - self._last_report < self._METRIC_PERIOD:
-                return ref
-            self._last_report = now
-            live = [(w(), k) for w, k in self._outstanding]
-            live = [(r, k) for r, k in live if r is not None]
-            if live:
-                import ray_tpu as _ray
-
-                done, pending = _ray.wait(
-                    [r for r, _ in live], num_returns=len(live), timeout=0)
-                pend_set = {r.id() for r in pending}
-                self._outstanding = [
-                    (w, k) for w, k in self._outstanding
-                    if (r := w()) is not None and r.id() in pend_set]
-                ongoing = len(self._outstanding)
-            else:
-                self._outstanding = []
-                ongoing = 0
-            counts: Dict[int, int] = {}
-            for _w, k in self._outstanding:
-                counts[k] = counts.get(k, 0) + 1
-            self._inflight = counts
-        # Fire-and-forget: the metric must never block the data path.
-        self._controller.record_handle_metric.remote(
-            self._name, self._handle_id, ongoing)
+        if self._note_dispatch(ref, replica):
+            # Fire-and-forget: the metric must never block the data
+            # path.  (_incarnation is a bare int read — a racing
+            # long-poll update at worst sends one report the controller
+            # drops as stale.)
+            self._controller.record_handle_metric.remote(
+                self._name, self._handle_id, self._ongoing,
+                self._incarnation)
         return ref
 
     def remote(self, *args, **kwargs):
@@ -495,6 +761,113 @@ class DeploymentHandle:
         return _M()
 
 
+@ray.remote
+class RequestProxy:
+    """Data-plane request proxy (the serving twin of the per-node HTTP
+    proxies, minus HTTP): a worker-resident actor holding worker-side
+    ``DeploymentHandle``s, so every replica call it routes rides the
+    DirectCaller actor channels — request/response payloads move over
+    the striped object plane and lease-granted dispatch, and steady-
+    state serving traffic adds ZERO ``head_brokered_submits`` (the head
+    sees only actor resolution + blocked/unblocked control messages).
+    Callers reach it through :class:`ProxiedDeploymentHandle`.
+
+    LOCK ORDER: ``_stats_lock`` is an independent leaf (counters only);
+    ``_create_lock`` serializes first-request handle construction and
+    is held across controller RPCs but never while another local serve
+    lock is held.
+    """
+
+    def __init__(self):
+        self._controller = ray.get_actor(CONTROLLER_NAME)
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._create_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._routed = 0
+
+    def ping(self):
+        return True
+
+    def _handle_for(self, name: str) -> DeploymentHandle:
+        h = self._handles.get(name)  # GIL-atomic read; writes below
+        if h is not None:
+            return h
+        with self._create_lock:
+            h = self._handles.get(name)
+            if h is None:
+                h = self._handles[name] = DeploymentHandle(
+                    name, self._controller)
+        return h
+
+    def handle_request(self, name: str, args, kwargs):
+        with self._stats_lock:
+            self._routed += 1
+        h = self._handle_for(name)
+        # Blocking get on a proxy thread (max_concurrency bounds the
+        # concurrent request streams): the replica's result payload is
+        # pulled over the data plane into this worker's store and
+        # returned as this call's own result.
+        return ray.get(h.remote(*args, **(kwargs or {})))
+
+    def call_method(self, name: str, method: str, args, kwargs):
+        with self._stats_lock:
+            self._routed += 1
+        h = self._handle_for(name)
+        return ray.get(h.method(method).remote(*args, **(kwargs or {})))
+
+    def proxy_stats(self):
+        with self._stats_lock:
+            return {"routed": self._routed,
+                    "deployments": sorted(self._handles)}
+
+
+class ProxiedDeploymentHandle(_P2CRouterBase):
+    """Caller-side handle that routes requests through the proxy tier
+    (``serve.start(num_proxies=N)``) instead of calling replicas
+    directly: proxy choice is power-of-two-choices on this handle's
+    live in-flight counts, replica choice happens inside the proxy
+    (its own p2c handle).  Drivers and external clients thus never
+    touch replica actors; their single actor call lands on a proxy
+    whose replica traffic stays on the direct data plane."""
+
+    def __init__(self, name: str, proxies: List[Any]):
+        if not proxies:
+            raise ValueError("proxy tier is empty")
+        self._name = name
+        self._proxies = list(proxies)
+        self._tier_gen = _state.get("proxy_tier_gen", 0)
+        self._router_init()
+
+    def _pick(self):
+        reps = self._proxies
+        if len(reps) == 1:
+            return reps[0]
+        with self._lock:
+            return self._pick_two_locked(reps)
+
+    def _track(self, ref, proxy):
+        # Same dispatch bookkeeping as DeploymentHandle, minus the
+        # controller metric (proxies report replica-side).
+        self._note_dispatch(ref, proxy)
+        return ref
+
+    def remote(self, *args, **kwargs):
+        p = self._pick()
+        return self._track(
+            p.handle_request.remote(self._name, args, kwargs), p)
+
+    def method(self, method_name: str):
+        handle = self
+
+        class _M:
+            def remote(self, *args, **kwargs):
+                p = handle._pick()
+                return handle._track(p.call_method.remote(
+                    handle._name, method_name, args, kwargs), p)
+
+        return _M()
+
+
 class Deployment:
     """Result of @serve.deployment — bind/deploy surface (reference:
     serve/deployment.py)."""
@@ -502,7 +875,8 @@ class Deployment:
     def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
                  num_cpus: float = 1, num_tpus: int = 0,
                  route_prefix: Optional[str] = None,
-                 autoscaling_config: Optional[Dict[str, Any]] = None):
+                 autoscaling_config: Optional[Dict[str, Any]] = None,
+                 max_concurrency: int = 8):
         self._cls_or_fn = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
@@ -512,6 +886,11 @@ class Deployment:
         # {min_replicas, max_replicas, target_ongoing_requests,
         #  downscale_delay_s} (reference: serve AutoscalingConfig)
         self.autoscaling_config = autoscaling_config
+        # Concurrent request threads per replica (reference:
+        # max_concurrent_queries).  A continuous-batching replica wants
+        # this ABOVE max_batch_size: callers park in the batcher, so
+        # the thread pool bounds admission, not batch occupancy.
+        self.max_concurrency = max_concurrency
         self._init_args = ()
         self._init_kwargs = {}
 
@@ -522,7 +901,8 @@ class Deployment:
                        kw.get("num_tpus", self.num_tpus),
                        kw.get("route_prefix", self.route_prefix),
                        kw.get("autoscaling_config",
-                              self.autoscaling_config))
+                              self.autoscaling_config),
+                       kw.get("max_concurrency", self.max_concurrency))
         d._init_args = self._init_args
         d._init_kwargs = self._init_kwargs
         return d
@@ -537,13 +917,14 @@ class Deployment:
 def deployment(cls_or_fn=None, *, name: Optional[str] = None,
                num_replicas: int = 1, num_cpus: float = 1,
                num_tpus: int = 0, route_prefix: Optional[str] = None,
-               autoscaling_config: Optional[Dict[str, Any]] = None):
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               max_concurrency: int = 8):
     """@serve.deployment (reference: serve/api.py deployment)."""
 
     def wrap(target):
         return Deployment(target, name or target.__name__, num_replicas,
                           num_cpus, num_tpus, route_prefix,
-                          autoscaling_config)
+                          autoscaling_config, max_concurrency)
 
     if cls_or_fn is not None:
         return wrap(cls_or_fn)
@@ -574,22 +955,75 @@ def run(target: Deployment, *, name: Optional[str] = None
         "num_cpus": target.num_cpus,
         "num_tpus": target.num_tpus,
         "autoscaling_config": target.autoscaling_config,
+        "max_concurrency": target.max_concurrency,
     }))
     # Route registered at the CONTROLLER so every node's proxy serves it
     # (the driver-thread proxy keeps its local copy too).
     ray.get(controller.set_route.remote(target.route_prefix, dep_name))
-    handle = DeploymentHandle(dep_name, controller)
+    old = _state["handles"].get(dep_name)
+    if isinstance(old, DeploymentHandle):
+        old.close()  # a redeploy replaces the cached handle: stop its poller
+    handle = _make_handle(dep_name, controller)
     _state["handles"][dep_name] = handle
     _state["routes"][target.route_prefix] = handle
     return handle
 
 
-def get_deployment_handle(name: str) -> DeploymentHandle:
+def _make_handle(name: str, controller):
+    """Proxy-tier routing when serve.start(num_proxies=N) ran; direct
+    replica routing otherwise."""
+    proxies = _state.get("request_proxies")
+    if proxies:
+        return ProxiedDeploymentHandle(name, proxies)
+    return DeploymentHandle(name, controller)
+
+
+def get_deployment_handle(name: str):
     h = _state["handles"].get(name)
-    if h is None:
-        h = DeploymentHandle(name, _get_controller())
-        _state["handles"][name] = h
+    proxies = _state.get("request_proxies")
+    stale = (proxies and isinstance(h, DeploymentHandle)) or \
+        (not proxies and isinstance(h, ProxiedDeploymentHandle)) or \
+        (isinstance(h, ProxiedDeploymentHandle)
+         and h._tier_gen != _state.get("proxy_tier_gen", 0))
+    if h is None or stale:
+        if isinstance(h, DeploymentHandle):
+            h.close()  # stop the replaced handle's long-poll thread
+        nh = _make_handle(name, _get_controller())
+        _state["handles"][name] = nh
+        # The routes table may hold the SAME object (serve.run stores
+        # one handle in both); the HTTP proxy reads routes directly, so
+        # swap it there too — a closed handle's replica set is frozen.
+        for prefix, rh in list(_state["routes"].items()):
+            if rh is h:
+                _state["routes"][prefix] = nh
+        h = nh
     return h
+
+
+def serving_stats(name: Optional[str] = None) -> Dict[str, Any]:
+    """Per-deployment serving observability snapshot (the serve-plane
+    analog of Runtime.transfer_stats()): replicas, queued/ongoing
+    requests, batch occupancy + step totals from the replica batchers,
+    autoscale scale-up/scale-down counters, and — when the proxy tier
+    is running — per-proxy routed counts."""
+    controller = _get_controller()
+    out = ray.get(controller.serving_stats.remote(name))
+    proxies = _state.get("request_proxies")
+    if proxies and name is None:
+        # Parallel with ONE shared deadline (same pattern as the
+        # controller's replica polls): N unreachable proxies cost one
+        # 5s wait, not N serialized timeouts.
+        refs = [p.proxy_stats.remote() for p in proxies]
+        done = set(ray.wait(refs, num_returns=len(refs), timeout=5)[0])
+        routed = []
+        for ref in refs:
+            try:
+                routed.append(ray.get(ref, timeout=1)["routed"]
+                              if ref in done else None)
+            except Exception:
+                routed.append(None)
+        out["_proxies"] = {"count": len(proxies), "routed": routed}
+    return out
 
 
 def start_http_proxy(host: str = "127.0.0.1", port: int = 8000):
@@ -743,14 +1177,57 @@ class HTTPProxyActor:
 
 
 def start(proxy_location: str = "HeadOnly", http_options: Optional[
-        Dict[str, Any]] = None) -> List[str]:
+        Dict[str, Any]] = None, num_proxies: int = 0) -> List[str]:
     """Start Serve ingress (reference: serve.start(proxy_location=...) —
     ProxyLocation.EveryNode runs one proxy per node).  Returns the proxy
-    URLs."""
+    URLs.
+
+    ``num_proxies=N`` additionally spawns N :class:`RequestProxy`
+    actors — the non-HTTP data-plane tier: handles created AFTER this
+    (serve.run / get_deployment_handle) route requests through them,
+    keeping steady-state request traffic off the head (proxy→replica
+    calls ride the DirectCaller actor channels).
+    ``proxy_location="Disabled"`` skips HTTP ingress entirely (request
+    proxies only)."""
     http_options = http_options or {}
     host = http_options.get("host", "127.0.0.1")
     port = int(http_options.get("port", 0))
     _get_controller()
+    if num_proxies > 0:
+        # A second start() replaces the tier: the OLD proxies are
+        # killed (their handles' pollers would otherwise poll the
+        # controller forever) and the tier generation bumps so cached
+        # ProxiedDeploymentHandles re-resolve onto the new actors.
+        old = _state.get("request_proxies") or []
+        proxies = [RequestProxy.options(
+            num_cpus=0, max_concurrency=32).remote()
+            for _ in range(num_proxies)]
+        ray.get(_bulk_submit([(p.ping, (), None) for p in proxies]))
+        _state["request_proxies"] = proxies
+        _state["proxy_tier_gen"] = _state.get("proxy_tier_gen", 0) + 1
+        for p in old:
+            try:
+                ray.kill(p)
+            except Exception:
+                pass
+        # Re-resolve every cached proxied handle onto the new tier —
+        # the HTTP proxy thread reads _state["routes"] directly and
+        # would otherwise dispatch onto the killed actors.  (Handles
+        # the USER kept from a pre-replacement serve.run go stale;
+        # re-fetch via get_deployment_handle after replacing the tier.)
+        fresh: Dict[str, ProxiedDeploymentHandle] = {}
+        for table in (_state["handles"], _state["routes"]):
+            for key, h in list(table.items()):
+                if isinstance(h, ProxiedDeploymentHandle):
+                    nh = fresh.get(h._name)
+                    if nh is None:
+                        nh = fresh[h._name] = ProxiedDeploymentHandle(
+                            h._name, proxies)
+                    table[key] = nh
+        # Existing direct handles keep working; fresh ones route through
+        # the tier (get_deployment_handle re-resolves cached entries).
+    if proxy_location == "Disabled":
+        return []
     if proxy_location != "EveryNode":
         return [start_http_proxy(host, port or 8000)]
     proxies = []
@@ -777,6 +1254,11 @@ def shutdown():
             ray.kill(p)
         except Exception:
             pass
+    for p in _state.pop("request_proxies", []) or []:
+        try:
+            ray.kill(p)
+        except Exception:
+            pass
     if _state["controller"] is not None:
         try:
             for name in list(
@@ -791,5 +1273,8 @@ def shutdown():
             proxy[2]["loop"].call_soon_threadsafe(proxy[2]["loop"].stop)
         except Exception:
             pass
+    for h in _state["handles"].values():
+        if isinstance(h, DeploymentHandle):
+            h.close()
     _state.update({"controller": None, "proxy": None, "handles": {},
                    "routes": {}})

@@ -110,7 +110,16 @@ def test_lazy_plan_fuses_ops(ray8):
 def test_streaming_window_bounds_inflight(ray8):
     """The executor keeps at most DEFAULT_STREAMING_WINDOW block tasks in
     flight: with 3x window blocks, consuming the first row must not have
-    executed every block (bulk execution would)."""
+    executed every block (bulk execution would).
+
+    Tasks are PACED (0.15s): with instant tasks the executed-count
+    assertion raced task completion against the driver's wakeup — on a
+    fast/idle host a third admission wave could start before next(it)
+    returned, tripping the 2x-window bound on identical code (observed
+    pre-existing flake, ~1 in 5 full-suite runs).  The pacing gives the
+    driver a full wave time of cushion; the timing-free concurrency
+    invariant is additionally pinned against the engine's own
+    peak_inflight counter."""
     import ray_tpu.data.dataset as dsmod
 
     marker_dir = "/tmp/rtpu_stream_markers_%d" % __import__("os").getpid()
@@ -122,7 +131,10 @@ def test_streaming_window_bounds_inflight(ray8):
     n_blocks = dsmod.DEFAULT_STREAMING_WINDOW * 3
 
     def touch(x):
+        import time as _t
+
         open(os.path.join(marker_dir, "%d_%d" % (x, os.getpid())), "w")
+        _t.sleep(0.15)
         return x
 
     ds = rd.range(n_blocks, parallelism=n_blocks).map(touch)
@@ -135,6 +147,11 @@ def test_streaming_window_bounds_inflight(ray8):
         f"{dsmod.DEFAULT_STREAMING_WINDOW}")
     rest = list(it)
     assert sorted([first] + rest) == list(range(n_blocks))
+    summary = ds._stats.streaming_summary()
+    if summary["ops"]:  # streaming engine on: concurrency never exceeded
+        cap = summary["inflight_cap"]
+        assert all(op["peak_inflight"] <= cap
+                   for op in summary["ops"].values()), summary["ops"]
     shutil.rmtree(marker_dir, ignore_errors=True)
 
 

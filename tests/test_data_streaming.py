@@ -274,6 +274,9 @@ def _best_of(n, fn):
     return best
 
 
+@pytest.mark.slow  # ~20s perf A/B; per the PR 6/7 convention perf
+# micros ride the slow tier — engine correctness keeps sub-second/
+# few-second tier-1 reps (backpressure, fusion, failure tests below).
 def test_streaming_overlap_micro_beats_legacy():
     """Acceptance: >=1.5x on the paced 3-stage pipeline, best-of-3.
     The streaming engine admits by BYTES (tiny blocks -> the whole

@@ -516,3 +516,48 @@ def test_fast_async_handler_records_no_stall(checker):
         loop.call_soon_threadsafe(loop.stop)
         thread.join(timeout=5)
         loop.close()
+
+
+def test_serve_batcher_locks_are_leaves(checker):
+    """serve/batching + serve/continuous documented convention: both
+    batcher locks are independent LEAVES — they guard only the pending
+    queue and counters, the wrapped/step function runs with no lock
+    held, and caller events are set outside them.  The recorded
+    acquisition graph must show zero outgoing edges from either lock
+    across a concurrent submit/step/retire cycle (including a stats
+    snapshot taken mid-flight, the serving_stats path)."""
+    from ray_tpu.serve.batching import _Batcher
+    from ray_tpu.serve.continuous import _ContinuousBatcher
+
+    def stepfn(slots):
+        time.sleep(0.001)
+        for s in slots:
+            s.state = (s.state or 0) + 1
+            if s.state >= s.request:
+                s.finish(s.state)
+
+    cont = _ContinuousBatcher(stepfn, None, 4, 0.0, continuous=True)
+    oneshot = _Batcher(lambda items: [x * 2 for x in items], None, 4,
+                       0.02)
+    assert isinstance(cont._lock, lockcheck._LockProxy)
+    assert isinstance(oneshot._lock, lockcheck._LockProxy)
+    results = []
+    threads = [threading.Thread(target=lambda n=n:
+                                results.append(cont.submit(n)))
+               for n in (1, 2, 3, 1, 2, 3)]
+    threads += [threading.Thread(target=lambda n=n:
+                                 results.append(oneshot.submit(n)))
+                for n in (4, 5, 6)]
+    for t in threads:
+        t.start()
+    cont.stats()  # concurrent snapshot while the batch runs
+    oneshot.stats()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 9
+    edges = checker.edges()
+    for site in (cont._lock._site, oneshot._lock._site):
+        assert edges.get(site, set()) == set(), (
+            f"a lock was acquired while holding a serve batcher lock: "
+            f"{edges.get(site)}")
+    checker.assert_acyclic()

@@ -103,6 +103,10 @@ def test_train_step_sharded_matches_single_device():
     assert max(jax.tree.leaves(d)) < 1e-4
 
 
+@pytest.mark.slow  # ~38s of multichip mesh dryruns (the single biggest
+# tier-1 sink); sharding coverage keeps its tier-1 representatives via
+# test_train_step_sharded_matches_single_device and the
+# test_sharded_loss_matches_single_device battery above.
 def test_graft_entry_dryrun():
     import sys, pathlib
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))

@@ -260,6 +260,154 @@ def test_serve_batch_coalesces(ray8):
     assert calls <= 6, calls
 
 
+def test_batch_leader_exception_fails_followers_not_hangs():
+    """Satellite pin: an exception landing in the LEADER before the
+    batch runs (async kill, interrupted wait) must set every follower
+    entry's event — nobody hangs forever."""
+    import threading
+
+    from ray_tpu.serve.batching import _Batcher
+
+    def fn(items):
+        return [x * 2 for x in items]
+
+    b = _Batcher(fn, None, max_batch_size=4, batch_wait_timeout_s=0.2)
+    orig_wait = b._full.wait
+    release = threading.Event()
+
+    def dying_wait(timeout=None):
+        release.wait(5)  # let followers enqueue first
+        raise RuntimeError("async kill in the batching window")
+
+    b._full.wait = dying_wait
+    results = {}
+
+    def leader():
+        try:
+            results["leader"] = ("ok", b.submit(1))
+        except BaseException as e:  # noqa: BLE001 — recorded for asserts
+            results["leader"] = ("err", e)
+
+    def follower():
+        b._full.wait = orig_wait  # only the first (leader) wait dies
+        try:
+            results["follower"] = ("ok", b.submit(2))
+        except BaseException as e:  # noqa: BLE001 — recorded for asserts
+            results["follower"] = ("err", e)
+
+    lt = threading.Thread(target=leader)
+    lt.start()
+    time.sleep(0.05)  # leader is parked in the window
+    ft = threading.Thread(target=follower)
+    ft.start()
+    time.sleep(0.05)
+    release.set()
+    lt.join(10)
+    ft.join(10)
+    assert not lt.is_alive() and not ft.is_alive(), "batch entry hung"
+    assert results["leader"][0] == "err"
+    assert results["follower"][0] == "err"
+    assert "leader failed" in str(results["follower"][1])
+    # The batcher stays usable: the next batch elects a fresh leader.
+    assert b.submit(3) == 6
+
+
+def test_batch_leader_death_rescued_by_follower_backstop(monkeypatch):
+    """Satellite pin: a HARD-killed leader (thread gone, no exception
+    path ran) leaves its entries pending forever in the old code; the
+    follower backstop must detect the dead leader and rescue-run the
+    pending batch."""
+    import threading
+
+    from ray_tpu.serve.batching import _Batcher, _Entry
+
+    monkeypatch.setattr(_Batcher, "_BACKSTOP_S", 0.1)
+
+    def fn(items):
+        return [x * 10 for x in items]
+
+    b = _Batcher(fn, None, max_batch_size=8, batch_wait_timeout_s=30.0)
+    # Simulate the post-mortem state: a leader that appended its entry
+    # and died before collecting the batch.
+    dead = threading.Thread(target=lambda: None)
+    dead.start()
+    dead.join()
+    orphan = _Entry(1)
+    with b._lock:
+        b._pending.append(orphan)
+        b._leader = dead
+    # A live follower joins the orphaned batch; its backstop must take
+    # over leadership and run BOTH entries.
+    assert b.submit(2) == 20
+    assert orphan.event.is_set() and orphan.result == 10
+
+
+def test_redeploy_same_name_ignores_stale_handle_metrics(ray8):
+    """Satellite pin: metric windows are keyed by (name, incarnation) —
+    a handle from a DELETED deployment keeps reporting, but its samples
+    must not feed the autoscaler of a same-name redeploy (the old
+    controller keyed by name only and scaled the fresh deployment on
+    the stale handle's ongoing count)."""
+    from ray_tpu.serve.api import _get_controller
+
+    cfg = {"min_replicas": 1, "max_replicas": 4,
+           "target_ongoing_requests": 1, "downscale_delay_s": 1.0}
+
+    @serve.deployment(autoscaling_config=cfg)
+    class A:
+        def __call__(self, body):
+            return "a"
+
+    handle = serve.run(A.bind(), name="redeploy")
+    controller = _get_controller()
+    assert ray.get(handle.remote({})) == "a"
+    stale_inc = ray.get(
+        controller.deployment_incarnation.remote("redeploy"))
+    ray.get(controller.delete_deployment.remote("redeploy"))
+
+    @serve.deployment(autoscaling_config=cfg)
+    class B:
+        def __call__(self, body):
+            return "b"
+
+    handle2 = serve.run(B.bind(), name="redeploy")
+    assert ray.get(handle2.remote({})) == "b"
+    new_inc = ray.get(
+        controller.deployment_incarnation.remote("redeploy"))
+    assert new_inc == stale_inc + 1
+    # A SURVIVING old handle re-keys itself: its long-poll carries the
+    # new incarnation along with the replica set, so a handle that
+    # keeps being used after a redeploy reports under the fresh key
+    # instead of being dropped forever.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        with handle._lock:
+            if handle._incarnation == new_inc:
+                break
+        time.sleep(0.2)
+    with handle._lock:
+        assert handle._incarnation == new_inc
+    # The stale handle screams "12 ongoing" (dangling refs against dead
+    # replicas).  Keyed by incarnation, the report is dropped...
+    assert ray.get(controller.record_handle_metric.remote(
+        "redeploy", "stale-handle", 12, stale_inc)) is False
+    for _ in range(3):
+        ray.get(controller.reconcile.remote())
+    assert ray.get(controller.num_replicas.remote("redeploy")) == 1
+    # ...while a current-incarnation report still drives scaling.
+    assert ray.get(controller.record_handle_metric.remote(
+        "redeploy", "live-handle", 4, new_inc)) is True
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        ray.get(controller.reconcile.remote())
+        if ray.get(controller.num_replicas.remote("redeploy")) == 4:
+            break
+        time.sleep(0.2)
+    assert ray.get(controller.num_replicas.remote("redeploy")) == 4
+    stats = ray.get(controller.serving_stats.remote("redeploy"))
+    assert stats["scale_ups"] >= 1
+
+
 def test_least_loaded_routing_skews_away_from_busy(ray8):
     @serve.deployment(num_replicas=2)
     class Sleepy:
