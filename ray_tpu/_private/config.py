@@ -208,10 +208,31 @@ class Config:
     # safe with JAX in the driver ("fork" is not — XLA runtime threads).
     worker_start_method: str = "forkserver"
 
-    # Lineage-based object reconstruction (reference:
-    # object_recovery_manager.h:41): keep creating-task specs for owned
-    # task returns; a lost object is rebuilt by re-executing its task.
+    # --- Fault tolerance (reference: object_recovery_manager.h:41 +
+    # task_manager.h:174 lineage pinning; Ownership, NSDI'21). ---
+    # Master switch for the recovery subsystem: lineage recording +
+    # object reconstruction (head-owned AND worker-owned), actor
+    # state-checkpoint hooks, and the recovery counters.  Off = a lost
+    # object surfaces ObjectLostError exactly as the legacy path did,
+    # with reconstructions / reconstruction_failures / actor_restarts /
+    # chaos_kills all zero.
+    recovery: bool = True
+    # Lineage-based object reconstruction: keep creating-task specs for
+    # owned task returns; a lost object is rebuilt by re-executing its
+    # task.  (Legacy escape hatch; ``recovery`` is the master switch.)
     lineage_enabled: bool = True
+    # Byte budget for each owner's retained lineage (the head's table
+    # and every worker's DirectCaller table independently): entries
+    # evict oldest-first past it, mirroring the reference's
+    # lineage-pinning cap (max_lineage_bytes).  Evicted lineage makes
+    # the objects unrecoverable — recovery then refuses, it never
+    # guesses.  0 = unbounded.
+    lineage_bytes_budget: int = 64 * 1024 * 1024
+    # Restartable actors: minimum seconds between automatic
+    # __ray_save__ checkpoints of an actor that defines the hooks
+    # (checkpoint bytes go through the object store, spill-aware).
+    # 0 = checkpoint after every method call.
+    actor_checkpoint_interval_s: float = 0.0
 
     # Where over-capacity shm objects spill (reference:
     # local_object_manager.h:41 spill to external storage).  Empty =

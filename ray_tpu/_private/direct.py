@@ -46,7 +46,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import protocol, recovery, serialization
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu import exceptions as exc
@@ -207,12 +207,29 @@ class DirectCaller:
         # bounced back.
         self.leased_submits = 0
         self.spillbacks = 0
+        # Worker-side lineage (reference: the owner retains its tasks'
+        # specs, task_manager.h:174): THIS process is the owner directory
+        # for its direct-submitted tasks, so reconstruction of their lost
+        # returns must run here — the head never saw the specs.  Bounded
+        # by the same byte budget as the head's table; None when the
+        # recovery subsystem is off (every counter then stays zero).
+        # LOCK ORDER: the table's _lock is an independent LEAF acquired
+        # under self.lock (record on submit, release on free) — pinned
+        # in tests/test_lockcheck.py.
+        cfg = GLOBAL_CONFIG
+        self.lineage = (recovery.LineageTable(cfg.lineage_bytes_budget)
+                        if cfg.recovery and cfg.lineage_enabled else None)
+        self.reconstructions = 0
+        self.reconstruction_failures = 0
 
     def stats(self) -> Dict[str, int]:
         """Counter snapshot for the xfer_stats delta shipper."""
         with self.lock:
             return {"leased_submits": self.leased_submits,
-                    "spillbacks": self.spillbacks}
+                    "spillbacks": self.spillbacks,
+                    "reconstructions": self.reconstructions,
+                    "reconstruction_failures":
+                        self.reconstruction_failures}
 
     # ------------------------------------------------------------- owned --
     def register_put(self, oid: ObjectID, descr, nested_local, nested_head):
@@ -274,6 +291,11 @@ class DirectCaller:
             # pinned consumers).  Mark for free-on-complete.
             return
         self.owned.pop(oid, None)
+        if self.lineage is not None:
+            # Lineage pinning ends with the object: the table entry
+            # drops when its last return object does (leaf lock; no
+            # resources to release worker-side).
+            self.lineage.release(oid.binary())
         if st.status == DELEGATED:
             # Head holds one aggregate ref for this process.
             self._outbound.append(("head", ("decref", oid.binary())))
@@ -369,6 +391,11 @@ class DirectCaller:
             return False
         if spec.get("runtime_env"):
             return False
+        if spec.get("retry_exceptions"):
+            # Opt-in app-error retry lives in the head's result path
+            # (one implementation of the retry budget); conservative
+            # eligibility is the direct plane's standing pattern.
+            return False
         res = spec.get("resources") or {}
         if any(k != "CPU" for k in res):
             return False
@@ -445,6 +472,13 @@ class DirectCaller:
             for spec in specs:
                 entry, states = self._register_entry_locked(
                     spec, spec.get("max_retries", 3))
+                if self.lineage is not None \
+                        and spec.get("num_returns", 0) > 0:
+                    # Owner-side lineage (metadata only — evicted
+                    # entries hold nothing to release here; a spec's
+                    # lost args reconstruct through their OWN lineage,
+                    # the head model).
+                    self.lineage.record(spec)
                 states_out.append(states)
                 if entry["deps"] == 0:
                     klass = self._sched_class(spec)
@@ -623,7 +657,9 @@ class DirectCaller:
                 # less entry is an error.
                 if st is None or st.descr is None:
                     raise exc.ObjectLostError(
-                        f"dependency {a[1].hex()} unavailable")
+                        object_id=a[1].hex(),
+                        owner=getattr(self.host, "worker_id_hex", None),
+                        phase="dispatch")
                 st.shipped = True
                 return st.descr
 
@@ -1393,8 +1429,10 @@ class DirectCaller:
         with self.lock:
             st = self.owned.get(oid)
             if st is None:
-                raise exc.ObjectLostError(
-                    f"Object {oid.hex()} is unknown or already freed")
+                raise exc.ObjectFreedError(
+                    object_id=oid.hex(),
+                    owner=getattr(self.host, "worker_id_hex", None),
+                    phase="get")
             if st.status == PENDING:
                 raise exc.GetTimeoutError(f"Object {oid.hex()} not ready")
             return st.descr, st
@@ -1403,6 +1441,102 @@ class DirectCaller:
         with self.lock:
             st = self.owned.get(oid)
             return None if st is None else st.status
+
+    # -------------------------------------------------------- recovery --
+    def _lost_object_hex(self, descr) -> Optional[str]:
+        """If an ERROR descriptor wraps a RECONSTRUCTABLE lost-object
+        failure (directly, or as a TaskError's cause — the shape an
+        executor's failed arg fetch produces), the lost object's id hex.
+        Keys off the structured error fields, never message text."""
+        if descr is None or descr[0] != protocol.ERROR:
+            return None
+        try:
+            err = serialization.loads_inline(descr[1])
+        except Exception:
+            return None
+        for e in (err, getattr(err, "cause", None)):
+            if isinstance(e, exc.ObjectLostError):
+                return e.object_id if e.reconstructable else None
+        return None
+
+    def reconstruct(self, oid: ObjectID, _visited=None) -> bool:
+        """Rebuild a lost OWNED object by re-executing its producer from
+        this caller's lineage (reference:
+        ObjectRecoveryManager::RecoverObject — run by the owner, which
+        is this process for direct-submitted tasks).  Covers both loss
+        shapes: a READY object whose segment died with its node, and an
+        ERRORED object whose producer failed fetching a lost argument —
+        the argument reconstructs first (recursively, cycle-safe via
+        ``_visited``), then the producer re-runs.  Bounded by the
+        lineage entry's max_retries budget; returns True when the
+        object is READY again (blocked getters already woke through the
+        ownership cv)."""
+        if self.lineage is None:
+            return False
+        visited = set() if _visited is None else _visited
+        prefix = oid.binary()[:12]
+        if prefix in visited:
+            return False  # cycle guard: never re-enter a producer
+        visited.add(prefix)
+        entry = self.lineage.get(prefix)
+        if entry is None:
+            with self.lock:
+                self.reconstruction_failures += 1
+            return False
+        spec = entry["spec"]
+        for _attempt in range(2):
+            with self.lock:
+                st = self.owned.get(oid)
+                if st is None or st.status == DELEGATED:
+                    return False  # freed, or the head owns it now
+                pending = st.status == PENDING
+                err_descr = (st.descr if st.status == ERRORED else None)
+            dep_hex = self._lost_object_hex(err_descr)  # loads: no lock
+            if dep_hex:
+                dep = ObjectID(bytes.fromhex(dep_hex))
+                with self.lock:
+                    dep_ours = dep in self.owned
+                if dep_ours and dep.binary()[:12] != prefix \
+                        and not self.reconstruct(dep, visited):
+                    break
+            if not pending:
+                if not self.lineage.note_attempt(prefix):
+                    break  # depleted retries: the loss stands
+                self._resubmit_spec(spec)
+            if not self.wait_owned([oid], timeout=60.0):
+                break
+            with self.lock:
+                st = self.owned.get(oid)
+                if st is not None and st.status == READY:
+                    return True
+            # ERRORED again: loop once — this attempt may have exposed
+            # a lost dependency the next pass can rebuild first.
+        with self.lock:
+            self.reconstruction_failures += 1
+        return False
+
+    def _resubmit_spec(self, spec: dict):
+        """Queue the producer again over the SAME task/object ids: the
+        owned return states flip back to PENDING (their existing refs
+        and waiters carry over — unlike submit_many, NO local_refs are
+        added) and the spec rides the normal push path, transparently
+        re-homing the results."""
+        tid = TaskID(spec["task_id"])
+        klass = self._sched_class(spec)
+        with self.lock:
+            for i in range(spec["num_returns"]):
+                rst = self.owned.get(tid.object_id(i))
+                if rst is not None and rst.status != DELEGATED:
+                    rst.status = PENDING
+                    rst.descr = None
+                    rst.attached = False
+                    rst.shipped = False
+                    rst.creator = None
+            self.reconstructions += 1
+            entry = {"spec": spec, "rid": None, "retries": 0, "deps": 0,
+                     "tid_bin": spec["task_id"], "pinned": ()}
+            self._pool_locked(klass)["queue"].append(entry)
+        self._pump(klass)
 
     # ------------------------------------------------------------- spill --
     def spill_owned(self, need_bytes: int, spill_dir: str) -> int:

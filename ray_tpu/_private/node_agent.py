@@ -32,7 +32,7 @@ import time
 from multiprocessing.connection import Client, Listener
 from typing import Dict
 
-from ray_tpu._private import object_transfer, protocol
+from ray_tpu._private import object_transfer, protocol, recovery
 from ray_tpu._private.shm_store import ShmStore
 
 
@@ -234,10 +234,19 @@ class NodeAgent:
                     break
                 continue
             tag = msg[0]
+            # Chaos syncpoint: one firing per control message lets a
+            # RAY_TPU_CHAOS "agent:agent_msg:N" rule take this node down
+            # deterministically mid-protocol (no-op unless armed).
+            recovery.syncpoint("agent_msg")
             if tag == "spawn_worker":
                 self._spawn_worker(msg[1], msg[2])
             elif tag == "kill_worker":
                 self._kill_worker(msg[1])
+            elif tag == "kill_worker_hard":
+                # SIGKILL, no graceful terminate: the chaos harness's
+                # worker-crash injection (a terminate lets atexit/finally
+                # blocks run, which is not what real crashes do).
+                self._kill_worker(msg[1], hard=True)
             elif tag == "read_segment":
                 threading.Thread(target=self._read_segment,
                                  args=(msg[1], msg[2]), daemon=True).start()
@@ -331,11 +340,11 @@ class NodeAgent:
         log_f.close()
         self.workers[worker_id_hex] = proc
 
-    def _kill_worker(self, worker_id_hex: str):
+    def _kill_worker(self, worker_id_hex: str, hard: bool = False):
         proc = self.workers.pop(worker_id_hex, None)
         if proc is not None:
             try:
-                proc.terminate()
+                proc.kill() if hard else proc.terminate()
             except Exception:
                 pass
 
@@ -379,6 +388,9 @@ class NodeAgent:
 
 
 def main():
+    # Opt-in chaos rules for agent processes (RAY_TPU_CHAOS,
+    # "agent:<point>:<n>"); zero cost when unset.
+    recovery.maybe_arm_env_chaos("agent")
     agent = NodeAgent(
         head_address=os.environ["RAY_TPU_HEAD_ADDRESS"],
         authkey=bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"]),

@@ -45,8 +45,13 @@ import weakref
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import protocol, recovery, serialization
 from ray_tpu._private.shm_store import _HEADER, _MAGIC
+
+
+# Structured ObjectLostError fields from a segment name (one
+# naming-rule implementation, recovery.py).
+_seg_oid_hex = recovery.seg_oid_hex
 
 logger = logging.getLogger(__name__)
 
@@ -564,7 +569,9 @@ class ObjectPuller(_PoolHost):
             from ray_tpu import exceptions as exc
 
             raise exc.ObjectLostError(
-                f"segment {name} unreadable at {store_id}: {reply[1]}")
+                f"segment {name} unreadable at {store_id}: {reply[1]}",
+                object_id=_seg_oid_hex(name), home=store_id,
+                phase="pull")
         total = reply[1]
         buf = bytearray(total) if sink is None else sink(total)
         view = memoryview(buf)
@@ -584,7 +591,9 @@ class ObjectPuller(_PoolHost):
         reply = protocol.recv(conn)
         if reply[0] != "ok":
             raise exc.ObjectLostError(
-                f"segment {name} unreadable at {store_id}: {reply[1]}")
+                f"segment {name} unreadable at {store_id}: {reply[1]}",
+                object_id=_seg_oid_hex(name), home=store_id,
+                phase="pull")
         _tag, first_n, total = reply
         buf = bytearray(total) if sink is None else sink(total)
         view = memoryview(buf)
@@ -607,7 +616,9 @@ class ObjectPuller(_PoolHost):
                 if r[0] != "ok" or r[1] != length:
                     raise exc.ObjectLostError(
                         f"segment {name} changed mid-stripe at "
-                        f"{store_id}: {r!r}")
+                        f"{store_id}: {r!r}",
+                        object_id=_seg_oid_hex(name), home=store_id,
+                        phase="pull")
                 _recv_range(c, view, off, length)
 
         def helper():
@@ -867,6 +878,10 @@ def _recv_range(conn, view: memoryview, off: int, n: int):
     ``view`` at ``off`` (one copy: socket -> destination buffer)."""
     got = 0
     while got < n:
+        # Chaos syncpoint (one global None-check when unarmed): a
+        # RAY_TPU_CHAOS rule can kill this process deterministically
+        # mid-stream — the chaos battery's "die during a striped pull".
+        recovery.syncpoint("pull_chunk")
         got += conn.recv_bytes_into(view, off + got)
     if got != n:
         raise OSError(

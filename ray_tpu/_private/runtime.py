@@ -36,7 +36,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private import object_transfer, protocol, serialization
+from ray_tpu._private import object_transfer, protocol, recovery, \
+    serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (
     ActorID,
@@ -98,13 +99,21 @@ class TaskRecord:
         "spec", "requirements", "deps_pending", "retries_left", "node",
         "worker", "dispatched", "cancelled", "is_actor_creation", "actor_id",
         "pg_id", "bundle_index", "sched_key", "locality_homes",
+        "app_retries_left",
     )
 
     def __init__(self, spec, requirements, retries_left):
         self.spec = spec
         self.requirements = requirements
         self.deps_pending = 0
+        # Two independent budgets, both seeded from max_retries:
+        # retries_left pays for SYSTEM failures (worker/node death —
+        # decremented in the death paths), app_retries_left for the
+        # retry_exceptions= opt-in application-error retries.  An app
+        # error must never burn a system-retry slot (and vice versa) —
+        # pinned by the retry-counting test.
         self.retries_left = retries_left
+        self.app_retries_left = retries_left
         self.node = None
         self.worker = None
         self.dispatched = False
@@ -144,7 +153,7 @@ class ActorState:
         "actor_id", "name", "namespace", "cls_payload", "func_id",
         "init_args", "init_kwargs", "options", "worker", "node", "status",
         "restarts_left", "queue", "inflight", "created_future",
-        "death_cause", "handle_count", "max_concurrency",
+        "death_cause", "handle_count", "max_concurrency", "checkpoint",
     )
 
     def __init__(self, actor_id):
@@ -166,6 +175,9 @@ class ActorState:
         self.death_cause = None
         self.handle_count = 0
         self.max_concurrency = 1
+        # Latest __ray_save__ state descriptor (restartable actors): the
+        # restart's create_actor carries it so __ray_restore__ can run.
+        self.checkpoint = None
 
 
 class WorkerHandle:
@@ -307,8 +319,8 @@ class AgentHandle:
         self.send(("read_segment", rid, name))
         ok, payload = fut.result(timeout=timeout)
         if not ok:
-            raise exc.ObjectLostError(
-                f"remote segment {name} unreadable: {payload}")
+            raise exc.ObjectLostError(object_id=_seg_oid_hex(name),
+                                      home=self.store_id, phase="relay")
         return payload  # (meta, [bytes...])
 
     def deliver(self, rid, ok, payload):
@@ -394,6 +406,11 @@ def worker_send_safe(worker: "WorkerHandle", msg):
         pass  # requester died; its death path cleans up
 
 
+# Every loss error carries the structured object_id field even at sites
+# that only see the segment (one naming-rule implementation, recovery.py).
+_seg_oid_hex = recovery.seg_oid_hex
+
+
 class Runtime:
     """The driver's runtime.  Public API (api.py) and ObjectRef route here."""
 
@@ -432,8 +449,10 @@ class Runtime:
         # Lineage: creating-task spec kept while any of its return objects
         # is alive, so a lost object can be rebuilt by re-execution
         # (reference: object_recovery_manager.h:41, task_manager.h:174
-        # lineage pinning).  {task_id_bytes: {"spec":, "alive": set}}
-        self.lineage: Dict[bytes, dict] = {}
+        # lineage pinning).  BOUNDED by lineage_bytes_budget — entries
+        # evict oldest-first past it and recovery then refuses (its own
+        # leaf _lock is pinned in tests/test_lockcheck.py).
+        self.lineage = recovery.LineageTable(config.lineage_bytes_budget)
         self.functions: Dict[str, bytes] = {}
         self.worker_funcs: Dict[int, set] = {}  # conn fileno -> func_ids sent
         self.task_events: deque = deque(maxlen=200_000)
@@ -499,6 +518,18 @@ class Runtime:
         self.head_brokered_submits = 0
         self.leased_submits = 0
         self.spillbacks = 0
+        # Recovery counters (all zero while config.recovery is off —
+        # pinned by tests): reconstructions = lost objects whose
+        # producer was re-queued from lineage (head-side, plus
+        # worker-side deltas via xfer_stats); reconstruction_failures =
+        # losses recovery could not cover (no/evicted lineage, depleted
+        # retries, non-reconstructable types); actor_restarts = actor
+        # respawns after worker/node death; chaos_kills = faults the
+        # chaos harness injected (ray_tpu.chaos).
+        self.reconstructions = 0
+        self.reconstruction_failures = 0
+        self.actor_restarts = 0
+        self.chaos_kills = 0
         # Identity of this process's object store: SHM descriptors carry it
         # so consumers know whether a segment is locally attachable or must
         # be shipped (reference: owner-based object directory).
@@ -1130,8 +1161,8 @@ class Runtime:
         with self.lock:
             st = self.objects.get(object_id)
             if st is None:
-                raise exc.ObjectLostError(
-                    f"Object {object_id.hex()} is unknown or already freed")
+                raise exc.ObjectFreedError(object_id=object_id.hex(),
+                                           owner="driver", phase="get")
             if st.status != PENDING:
                 inner.set_result(object_id)
             else:
@@ -1151,8 +1182,8 @@ class Runtime:
         with self.lock:
             st = self.objects.get(oid)
             if st is None:
-                raise exc.ObjectLostError(
-                    f"Object {oid.hex()} was freed before get")
+                raise exc.ObjectFreedError(object_id=oid.hex(),
+                                           owner="driver", phase="get")
             if st.has_value and st.status == READY:
                 return st.value
             descr = st.descr
@@ -1209,9 +1240,9 @@ class Runtime:
                     # between descriptor read and attach.
                     return self._materialize(oid, _recovering=_recovering)
                 if _recovering or not self._recover_and_wait(oid):
-                    raise exc.ObjectLostError(
-                        f"Object {oid.hex()}: segment {descr[1]} missing "
-                        f"and not recoverable")
+                    raise exc.ObjectLostError(object_id=oid.hex(),
+                                              home=self.store_id,
+                                              owner="driver", phase="get")
                 return self._materialize(oid, _recovering=True)
             value = seg.deserialize()
             with self.lock:
@@ -1237,40 +1268,42 @@ class Runtime:
             raise serialization.loads_inline(descr[1])
         return value
 
+    def _recovery_on(self) -> bool:
+        return self.config.recovery and self.config.lineage_enabled
+
     def _register_lineage_locked(self, spec: dict):
-        if not self.config.lineage_enabled:
+        if not self._recovery_on():
             return
         if "actor_id" in spec or spec.get("num_returns", 0) <= 0:
             return  # actor methods have side effects; no re-execution
-        tid = TaskID(spec["task_id"])
         # Keyed by the 12-byte task prefix: an ObjectID carries only the
         # prefix of its creating TaskID (ids.py), so recovery must be able
-        # to go oid -> lineage without the full 16-byte task id.
-        self.lineage[spec["task_id"][:12]] = {
-            "spec": spec,
-            "alive": {tid.object_id(i).binary()
-                      for i in range(spec["num_returns"])},
-        }
+        # to go oid -> lineage without the full 16-byte task id.  The
+        # table bounds itself: entries evicted for the byte budget get
+        # their pinned spec resources released here, at the caller's
+        # locking level (table _lock is a leaf; it runs no callbacks) —
+        # EXCEPT specs whose task is still queued/in flight: their
+        # nested-ref pins and by-value arg segments are live execution
+        # state, released by the completion path instead (which
+        # re-checks lineage membership and finds the entry gone).
+        for old in self.lineage.record(
+                spec, default_retries=self.config.default_max_retries):
+            if old["spec"]["task_id"] not in self.tasks:
+                self._release_spec_resources_locked(old["spec"])
 
     def _release_lineage_for_locked(self, oid: ObjectID):
-        entry = self.lineage.get(oid.task_prefix())
-        if entry is None:
-            return
-        entry["alive"].discard(oid.binary())
-        if not entry["alive"]:
-            spec = entry["spec"]
-            self.lineage.pop(spec["task_id"][:12], None)
+        entry = self.lineage.release(oid.binary())
+        if entry is not None:
             # The last return object is gone: nothing can ask for
             # re-execution anymore, so the nested-ref pins and by-value arg
             # segments held for it are released now.
-            self._release_spec_resources_locked(spec)
+            self._release_spec_resources_locked(entry["spec"])
 
     def _oid_from_segment_name(self, name: str) -> Optional[ObjectID]:
-        """Segment names are rtpu-<session>-<oid hex> (shm_store.py)."""
-        try:
-            return ObjectID(bytes.fromhex(name.rsplit("-", 1)[1]))
-        except Exception:
-            return None
+        """Segment names are rtpu-<session>-<oid hex> (shm_store.py;
+        one naming-rule implementation, recovery.seg_oid_hex)."""
+        oid_hex = recovery.seg_oid_hex(name)
+        return None if oid_hex is None else ObjectID(bytes.fromhex(oid_hex))
 
     def _store_is_dead(self, store_hex: str) -> bool:
         if store_hex == self.store_id:
@@ -1280,14 +1313,21 @@ class Runtime:
 
     def _try_recover_locked(self, oid: ObjectID) -> bool:
         """Queue re-execution of ``oid``'s creating task (reference:
-        ObjectRecoveryManager::RecoverObject).  Returns False if no lineage
-        exists (puts, actor results, released lineage)."""
+        ObjectRecoveryManager::RecoverObject).  Returns False when
+        recovery is off, no lineage exists (puts, actor results,
+        released/evicted lineage), or the entry's reconstruction budget
+        — per-task max_retries, a SYSTEM-failure budget — is spent."""
+        if not self._recovery_on():
+            return False
         entry = self.lineage.get(oid.task_prefix())
         if entry is None:
             return False
         spec = entry["spec"]
         if spec["task_id"] in self.tasks:
             return True  # already re-executing
+        if not self.lineage.note_attempt(oid.task_prefix()):
+            return False  # depleted retries: the loss stands
+        self.reconstructions += 1
         tid = TaskID(spec["task_id"])
         for i in range(spec["num_returns"]):
             oid_i = tid.object_id(i)
@@ -1330,22 +1370,70 @@ class Runtime:
 
     def _recover_and_wait(self, oid: ObjectID, timeout=60.0) -> bool:
         """Trigger lineage recovery and block until the object is READY
-        again.  Call WITHOUT the runtime lock."""
+        again.  Call WITHOUT the runtime lock.  A False return is a
+        counted reconstruction failure — the caller surfaces
+        ObjectLostError (zero failures counted while recovery is off:
+        the refusal is then the legacy path, not a failure of it)."""
         ev = threading.Event()
-        with self.lock:
-            if not self._try_recover_locked(oid):
+        ok = False
+        known = False
+        try:
+            with self.lock:
+                # "Known" scopes the failure counter: a refusal for an
+                # object the head never owned (a worker-owned segment
+                # relayed through getparts) is not a head recovery
+                # failure — the OWNER's lineage may still rebuild it.
+                known = (oid in self.objects
+                         or self.lineage.get(oid.task_prefix())
+                         is not None)
+                if not self._try_recover_locked(oid):
+                    return False
+                st = self.objects.get(oid)
+                if st is None:
+                    return False
+                if st.status != PENDING:
+                    ok = st.status == READY
+                    return ok
+                st.waiters.append(lambda _oid: ev.set())
+            if not ev.wait(timeout):
                 return False
-            st = self.objects.get(oid)
-            if st is None:
-                return False
-            if st.status != PENDING:
-                return st.status == READY
-            st.waiters.append(lambda _oid: ev.set())
-        if not ev.wait(timeout):
-            return False
+            with self.lock:
+                st = self.objects.get(oid)
+                ok = st is not None and st.status == READY
+                return ok
+        finally:
+            if not ok and known and self._recovery_on():
+                with self.lock:
+                    self.reconstruction_failures += 1
+
+    def _recover_for_worker(self, worker: "WorkerHandle",
+                            oid: ObjectID) -> bool:
+        """Run lineage recovery on a WORKER's behalf (the getparts relay
+        hit a dead store), releasing the requester's lease slot for the
+        duration — the same credit the blocked/unblocked envelope moves.
+        Without this, a node full of workers all blocked fetching args
+        from a dead peer deadlocks recovery: the re-executed producers
+        would have no slot to run on (the getters hold them all), which
+        is exactly the cluster state after a node loss."""
+        released = False
         with self.lock:
-            st = self.objects.get(oid)
-            return st is not None and st.status == READY
+            if worker.lease_req is not None and not worker.released \
+                    and worker.lease_pg is None and not worker.dead:
+                worker.blocked = True
+                worker.node.release(worker.lease_req)
+                worker.released = True
+                released = True
+                self._request_dispatch_locked()
+        try:
+            return self._recover_and_wait(oid)
+        finally:
+            if released:
+                with self.lock:
+                    if not worker.dead and worker.lease_req is not None \
+                            and worker.released:
+                        worker.node.acquire(worker.lease_req)
+                        worker.released = False
+                    worker.blocked = False
 
     def _fetch_parts(self, descr):
         """Serialized (meta, buffers) of a SHM descriptor, shipping across
@@ -1365,9 +1453,8 @@ class Runtime:
         with self.lock:
             agent = self._agents.get(home)
         if agent is None or agent.dead:
-            raise exc.ObjectLostError(
-                f"object store {home} is gone (node died); segment "
-                f"{descr[1]} unrecoverable")
+            raise exc.ObjectLostError(object_id=_seg_oid_hex(descr[1]),
+                                      home=home, phase="pull")
         addr = agent.info.get("object_addr")
         if addr:
             # Direct chunked pull from the home node's object server,
@@ -1398,8 +1485,8 @@ class Runtime:
             with self.lock:
                 st = self.objects.get(oid)
                 if st is None:
-                    raise exc.ObjectLostError(
-                        f"Object {oid.hex()} is unknown or already freed")
+                    raise exc.ObjectFreedError(object_id=oid.hex(),
+                                               owner="driver", phase="get")
                 if st.status == PENDING:
                     st.waiters.append(lambda _oid, ev=ev: ev.set())
                 else:
@@ -2123,6 +2210,14 @@ class Runtime:
                 str(self.config.serve_metric_lookback_s),
             "RAY_TPU_SERVE_DOWNSCALE_DELAY_S":
                 str(self.config.serve_downscale_delay_s),
+            # Fault-tolerance knobs: workers keep their own bounded
+            # lineage for direct-path tasks and arm actor checkpoint
+            # hooks — both must see the driver's _system_config.
+            "RAY_TPU_RECOVERY": "1" if self.config.recovery else "0",
+            "RAY_TPU_LINEAGE_BYTES_BUDGET":
+                str(self.config.lineage_bytes_budget),
+            "RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S":
+                str(self.config.actor_checkpoint_interval_s),
         }
 
     def _spawn_worker(self, node: NodeState, env_key: str,
@@ -2391,6 +2486,7 @@ class Runtime:
         queue — an immediate empty reply made every concurrent caller dump
         its whole queue on the head the moment leases momentarily ran out,
         which is what collapsed multi-client task throughput."""
+        recovery.syncpoint("lease_grant")
         req = {k: float(v) for k, v in resources.items()}
         with self.lock:
             granted = self._try_client_grant_locked(
@@ -2645,6 +2741,10 @@ class Runtime:
                                       klass_items=klass_items)
 
     def _send_task(self, worker: WorkerHandle, rec: TaskRecord):
+        # Chaos syncpoint (one global None-check when unarmed): lets the
+        # harness kill a worker/agent deterministically at the n-th
+        # dispatch instead of racing wall-clock timers.
+        recovery.syncpoint("dispatch")
         spec = rec.spec
         # Substitute resolved dependencies with value descriptors.
         def subst(a):
@@ -2652,8 +2752,9 @@ class Runtime:
                 oid = ObjectID(a[1])
                 st = self.objects.get(oid)
                 if st is None:
-                    raise exc.ObjectLostError(
-                        f"Dependency {oid.hex()} lost")
+                    raise exc.ObjectLostError(object_id=oid.hex(),
+                                              owner="driver",
+                                              phase="dispatch")
                 if st.status == ERRORED:
                     return st.descr  # error propagates to the task
                 st.shipped = True
@@ -2693,6 +2794,20 @@ class Runtime:
             sent.add(func_id)
         if rec.is_actor_creation:
             actor = self.actors[rec.actor_id]
+            # Restartable-actor checkpointing: the worker arms the
+            # __ray_save__ hook only when recovery is on AND the actor
+            # can actually restart; a retained checkpoint whose home
+            # store died with its node is dropped (fresh __init__ beats
+            # a restore that can only fail).
+            ck = actor.checkpoint
+            if ck is not None and len(ck) > 3 \
+                    and self._store_is_dead(ck[3]):
+                ck = None
+            ck_interval = (self.config.actor_checkpoint_interval_s
+                           if (self.config.recovery
+                               and actor.options.get("max_restarts", 0)
+                               != 0)
+                           else None)
             worker.queue_msg(("create_actor", {
                 "task_id": spec["task_id"],
                 "actor_id": rec.actor_id,
@@ -2702,6 +2817,8 @@ class Runtime:
                 "name": spec.get("name"),
                 "resources": rec.requirements,
                 "max_concurrency": actor.max_concurrency,
+                "checkpoint": ck,
+                "checkpoint_interval": ck_interval,
             }))
         else:
             worker.queue_msg(("exec", msg_task))
@@ -2929,6 +3046,10 @@ class Runtime:
             self._fail_task_locked(rec, exc.ActorDiedError(
                 f"Actor is dead: {cause}"))
             return None
+        # Method calls replay across actor restarts per the ACTOR's
+        # max_task_retries (0 = fail on death, the legacy default; -1 =
+        # unlimited) — not the plain-task max_retries default.
+        rec.retries_left = actor.options.get("max_task_retries", 0)
         actor.queue.append(rec)
         return rec.actor_id
 
@@ -3347,6 +3468,11 @@ class Runtime:
                     "prefetch_waste_bytes", 0)
                 self.leased_submits += d.get("leased_submits", 0)
                 self.spillbacks += d.get("spillbacks", 0)
+                # Worker-owned (direct-path) lineage reconstructions ride
+                # the same delta stream as every holder-side counter.
+                self.reconstructions += d.get("reconstructions", 0)
+                self.reconstruction_failures += d.get(
+                    "reconstruction_failures", 0)
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
         elif tag == "result_batch":
@@ -3380,7 +3506,9 @@ class Runtime:
                         # then ship the rebuilt object (reference:
                         # object_recovery_manager.h:41).
                         oid = self._oid_from_segment_name(descr[1])
-                        if oid is None or not self._recover_and_wait(oid):
+                        if oid is None \
+                                or not self._recover_for_worker(worker,
+                                                                oid):
                             raise
                         with self.lock:
                             st = self.objects.get(oid)
@@ -3405,7 +3533,9 @@ class Runtime:
                 except BaseException as e:  # noqa: BLE001
                     err = serialization.dumps_inline(
                         e if isinstance(e, exc.RayTpuError)
-                        else exc.ObjectLostError(repr(e)))
+                        else exc.ObjectLostError(
+                            repr(e), object_id=_seg_oid_hex(descr[1]),
+                            phase="relay"))
                     worker.send(("obj", rid, False, (protocol.ERROR, err)))
 
             threading.Thread(target=fetch_and_reply, daemon=True).start()
@@ -3892,6 +4022,21 @@ class Runtime:
                         and not worker.dead and worker.actor_id is None:
                     self._end_lease_locked(worker)
                 self._request_dispatch_locked()
+        elif tag == "actor_checkpoint":
+            # Latest __ray_save__ state from a restartable actor's
+            # worker: retain the descriptor for the next restart's
+            # __ray_restore__; the superseded checkpoint's storage is
+            # freed (checkpoints live outside the object table).
+            _, aid, descr = msg
+            with self.lock:
+                actor = self.actors.get(aid)
+                if actor is None or actor.status == DEAD:
+                    # Racing a death/GC: don't strand the bytes.
+                    self._free_checkpoint_locked(actor, descr)
+                else:
+                    old, actor.checkpoint = actor.checkpoint, descr
+                    if old is not None:
+                        self._free_checkpoint_locked(actor, old)
         elif tag == "actor_exit":
             pass
 
@@ -3922,8 +4067,8 @@ class Runtime:
             for b in id_bins:
                 st = self.objects.get(ObjectID(b))
                 if st is None:
-                    err = serialization.dumps_inline(exc.ObjectLostError(
-                        f"Object {b.hex()} is unknown or already freed"))
+                    err = serialization.dumps_inline(exc.ObjectFreedError(
+                        object_id=b.hex(), owner="driver", phase="get"))
                     out.append((False, (protocol.ERROR, err)))
                 elif st.status == PENDING:
                     err = serialization.dumps_inline(exc.GetTimeoutError(
@@ -3981,9 +4126,57 @@ class Runtime:
 
     def _on_result(self, worker: WorkerHandle, task_id_bin, ok, returns,
                    meta):
+        recovery.syncpoint("result")
+        retry_err = None
+        if not ok and returns and returns[0][0] == protocol.ERROR:
+            # Only tasks that OPTED INTO retry_exceptions get their
+            # error payload deserialized (outside the lock — RTL402);
+            # for everyone else the head keeps treating error bytes as
+            # opaque, exactly as before — a failure storm must not turn
+            # the result loop into a user-exception unpickling loop.
+            with self.lock:
+                rec0 = self.tasks.get(task_id_bin)
+                wants_retry = (rec0 is not None
+                               and rec0.spec.get("retry_exceptions")
+                               and rec0.app_retries_left > 0
+                               and rec0.actor_id is None
+                               and not rec0.is_actor_creation
+                               and not rec0.cancelled)
+            if wants_retry:
+                # An unloadable payload just skips the retry check.
+                try:
+                    retry_err = serialization.loads_inline(returns[0][1])
+                except Exception:
+                    retry_err = None
         with self.lock:
             rec = self.tasks.pop(task_id_bin, None)
             if rec is None:
+                return
+            if (retry_err is not None and not rec.is_actor_creation
+                    and rec.actor_id is None and not rec.cancelled
+                    and rec.app_retries_left > 0
+                    and recovery.retry_matches(
+                        rec.spec.get("retry_exceptions"), retry_err)):
+                # Opt-in APPLICATION-error retry: re-queue the task
+                # instead of completing its error objects.  Draws from
+                # its own budget — the system-failure retries_left is
+                # untouched (max_retries decrements only on worker/node
+                # death; pinned by the retry-counting test).
+                rec.app_retries_left -= 1
+                rec.dispatched = False
+                rec.worker = None
+                self.tasks[task_id_bin] = rec
+                worker.inflight.pop(task_id_bin, None)
+                self.task_events.append(
+                    {"task_id": task_id_bin.hex(),
+                     "name": rec.spec.get("name"),
+                     "state": "RETRYING", "time": time.time()})
+                self._enqueue_pending_locked(rec)
+                self._request_dispatch_locked([rec.sched_key])
+                if not worker.inflight and not worker.dead \
+                        and worker.lease_req is not None:
+                    self._end_lease_locked(worker)
+                    self._request_dispatch_locked()
                 return
             tid = TaskID(task_id_bin)
             for i, descr in enumerate(returns):
@@ -4146,14 +4339,15 @@ class Runtime:
             worker.client_lease = None
             # Pending-export shells this worker owed a completion for:
             # the owner is gone, fail them (owner-death semantics).
-            err = None
             for oid, st in list(self.objects.items()):
                 if st.exporter is worker and st.status == PENDING:
-                    if err is None:
-                        err = (protocol.ERROR, serialization.dumps_inline(  # noqa: RTL402 -- cold worker-death path; constant-sized error payload
-                            exc.ObjectLostError(
-                                "Owner worker died before completing "
-                                "its exported object")))
+                    # OwnerDiedError (non-reconstructable): the exporter
+                    # was the metadata authority; its lineage died too.
+                    err = (protocol.ERROR, serialization.dumps_inline(  # noqa: RTL402 -- cold worker-death path; constant-sized error payload
+                        exc.OwnerDiedError(
+                            object_id=oid.hex(),
+                            owner=worker.worker_id.hex(),
+                            phase="export")))
                     st.exporter = None
                     self._complete_object_locked(oid, err, False)
             if worker.actor_id is not None:
@@ -4199,14 +4393,39 @@ class Runtime:
         req = actor.options.get("resources") or {"CPU": 1.0}
         err = exc.ActorDiedError(
             f"Actor {worker.actor_id.hex()} died (worker exit)")
+        will_restart = actor.restarts_left != 0 and not self._stopped
+        # In-flight method calls: replayed onto the restarted actor per
+        # max_task_retries (at-least-once — the call may have partially
+        # executed before the death, exactly the reference's contract),
+        # else failed with ActorDiedError.  Queued-but-undispatched
+        # calls always survive the restart (they never reached the dead
+        # worker).  Worker/node death is a SYSTEM failure: it alone
+        # decrements the replay budget.
+        replay: List[TaskRecord] = []
+        mtr = actor.options.get("max_task_retries", 0)
         for tid_bin, rec in list(actor.inflight.items()):
-            self._fail_task_locked(rec, err)
+            if (will_restart and self.config.recovery and mtr != 0
+                    and (mtr < 0 or rec.retries_left > 0)
+                    and not rec.cancelled):
+                if rec.retries_left > 0:
+                    rec.retries_left -= 1
+                rec.dispatched = False
+                rec.worker = None
+                replay.append(rec)
+            else:
+                self._fail_task_locked(rec, err)
         actor.inflight.clear()
         actor.worker = None
-        if actor.restarts_left != 0 and not self._stopped:
+        if will_restart:
             if actor.restarts_left > 0:
                 actor.restarts_left -= 1
             actor.status = RESTARTING
+            if self.config.recovery:
+                self.actor_restarts += 1
+            # Replayed calls go BACK TO THE FRONT in their original send
+            # order, ahead of anything queued behind them.
+            for rec in reversed(replay):
+                actor.queue.appendleft(rec)
             spec = {
                 "task_id": new_task_id().binary(),
                 "func_id": actor.func_id,
@@ -4235,11 +4454,42 @@ class Runtime:
             actor.death_cause = err
             self._gcs_dirty += 1
             self._fail_actor_queue_locked(actor, err)
+            self._free_checkpoint_locked(actor)
             # The lease just returned the actor's resources: anything
             # waiting on capacity (pending tasks, parked client leases)
             # must get a dispatch pass — without this, a task submitted
             # while the actor held the last slot pends forever.
             self._dispatch_locked()
+
+    def _free_checkpoint_locked(self, actor: Optional[ActorState],
+                                descr=None):
+        """Unlink a checkpoint's storage (the superseded one on refresh,
+        the last one at actor death).  Checkpoint segments live outside
+        the object table, so their lifecycle is managed here: home-store
+        routed like free_remote."""
+        if descr is None:
+            if actor is None:
+                return
+            descr, actor.checkpoint = actor.checkpoint, None
+        if descr is None or descr[0] not in (protocol.SHM,
+                                             protocol.SPILLED):
+            return
+        home = descr[3] if len(descr) > 3 else self.store_id
+        if home == self.store_id:
+            try:
+                if descr[0] == protocol.SPILLED:
+                    os.unlink(descr[1])
+                else:
+                    self.shm.unlink(descr[1], descr[2], reusable=False)
+            except Exception:
+                pass
+        else:
+            agent = self._agents.get(home)
+            if agent is not None and not agent.dead:
+                try:
+                    agent.send(("unlink_segment", descr[1], descr[2]))
+                except Exception:
+                    pass
 
     # ----------------------------------------------------- memory monitor --
     def _memory_monitor_loop(self):
@@ -4779,6 +5029,10 @@ class Runtime:
                 "spillbacks": self.spillbacks,
                 "lease_revocations": self.lease_revocations,
                 "head_brokered_submits": self.head_brokered_submits,
+                "reconstructions": self.reconstructions,
+                "reconstruction_failures": self.reconstruction_failures,
+                "actor_restarts": self.actor_restarts,
+                "chaos_kills": self.chaos_kills,
             }
 
     def list_nodes(self):

@@ -32,7 +32,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
 from ray_tpu._private import direct as direct_mod
-from ray_tpu._private import object_transfer, protocol, serialization
+from ray_tpu._private import object_transfer, protocol, recovery, \
+    serialization
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, new_task_id
 from ray_tpu._private import object_ref as object_ref_mod
 from ray_tpu._private.object_ref import ObjectRef
@@ -146,6 +147,11 @@ class _WorkerRuntime:
         # the head is only the lease scheduler for them).
         self._fn_payloads: Dict[str, bytes] = {}
         self.direct = direct_mod.DirectCaller(self)
+        # Restartable-actor checkpointing: actor_id -> {"interval",
+        # "last"} armed at create_actor when the head said the actor can
+        # restart AND the class defines __ray_save__/__ray_restore__.
+        self._actor_ck: Dict[bytes, dict] = {}
+        self._actor_ck_lock = threading.Lock()
 
     # -- peer messaging (ring collectives etc.) ----------------------------
     def register_peer_handler(self, channel: str, fn):
@@ -389,6 +395,44 @@ class _WorkerRuntime:
 
     # -- descriptor handling ----------------------------------------------
     def materialize(self, descr) -> Any:
+        try:
+            return self._materialize_tracked(descr)
+        except exc.ObjectLostError as e:
+            # Lost segment: if WE own the object and its lineage
+            # survives, re-execute the producer and consume the re-homed
+            # result (reference: ObjectRecoveryManager — recovery runs
+            # at the owner; head-owned objects already recovered inside
+            # the getparts relay, so reaching here means the head
+            # refused).
+            if not e.reconstructable:
+                raise
+            oid = self._owned_oid_of(descr)
+            if oid is None or not self.direct.reconstruct(oid):
+                raise
+            try:
+                descr2, _st = self.direct.descr_of(oid)
+            except Exception:
+                raise e from None
+            if descr2 is None or descr2[0] == protocol.ERROR:
+                raise
+            return self._materialize_tracked(descr2)
+
+    def _owned_oid_of(self, descr) -> Optional[ObjectID]:
+        """The owned ObjectID a SHM/SPILLED descriptor names (segment
+        names embed the oid hex), or None when it isn't ours to
+        recover."""
+        if descr is None or descr[0] not in (protocol.SHM,
+                                             protocol.SPILLED):
+            return None
+        oid_hex = recovery.seg_oid_hex(descr[1])
+        if oid_hex is None:
+            return None
+        oid = ObjectID(bytes.fromhex(oid_hex))
+        if self.direct.status_of(oid) in (None, direct_mod.DELEGATED):
+            return None
+        return oid
+
+    def _materialize_tracked(self, descr) -> Any:
         prev = getattr(self._tls, "reg_load", None)
         self._tls.reg_load = []
         try:
@@ -690,6 +734,9 @@ class _WorkerRuntime:
                         continue
                     descr, st = self.direct.descr_of(oid)
                     if descr[0] == protocol.ERROR:
+                        descr, st = self._maybe_recover_owned(oid, descr,
+                                                              st)
+                    if descr[0] == protocol.ERROR:
                         raise self.materialize_error(descr)
                     values[i] = self.materialize(descr)
                     if descr[0] == protocol.SHM:
@@ -711,6 +758,23 @@ class _WorkerRuntime:
             if notify:
                 self._send(("unblocked", tid.binary() if tid else b""))
         return values
+
+    def _maybe_recover_owned(self, oid: ObjectID, descr, st):
+        """An ERRORED owned object whose failure wraps a reconstructable
+        loss (the producer couldn't fetch a lost argument, or its worker
+        died holding the only copy): rebuild through this owner's
+        lineage and return the refreshed (descr, state); on refusal the
+        original error stands."""
+        if self.direct.lineage is None:
+            return descr, st
+        if self.direct._lost_object_hex(descr) is None:
+            return descr, st
+        if not self.direct.reconstruct(oid):
+            return descr, st
+        try:
+            return self.direct.descr_of(oid)
+        except Exception:
+            return descr, st
 
     def materialize_error(self, descr):
         try:
@@ -928,6 +992,43 @@ class _WorkerRuntime:
     def is_worker(self):
         return True
 
+    # -- restartable-actor checkpoints -------------------------------------
+    def arm_actor_checkpoint(self, actor_id: bytes, actor,
+                             interval) -> None:
+        """Arm periodic __ray_save__ checkpointing for one actor (only
+        when the head sent an interval — recovery on + max_restarts != 0
+        — and the class actually defines the hook)."""
+        if interval is None or not hasattr(actor, "__ray_save__"):
+            return
+        with self._actor_ck_lock:
+            self._actor_ck[actor_id] = {"interval": float(interval),
+                                        "last": 0.0}
+
+    def maybe_checkpoint_actor(self, actor_id: bytes, actor) -> None:
+        """After a successful method call: serialize __ray_save__ state
+        through the store (spill-aware — serialize_value's store-full
+        path) and ship the DESCRIPTOR to the head, which retains it for
+        the next restart's __ray_restore__.  Throttled by
+        actor_checkpoint_interval_s; a failing checkpoint never fails
+        the method call that triggered it."""
+        ck = self._actor_ck.get(actor_id)
+        if ck is None:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        with self._actor_ck_lock:
+            if ck["last"] and now - ck["last"] < ck["interval"]:
+                return
+            ck["last"] = now
+        try:
+            state = actor.__ray_save__()
+            oid = ObjectID.for_put()
+            descr = self.serialize_value(state, oid)
+            self._send(("actor_checkpoint", actor_id, descr))
+        except Exception:
+            traceback.print_exc()
+
 
 _PULL_MISS = object()
 
@@ -1042,6 +1143,7 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
     store returns (small inline to owner, large to plasma/shm)."""
     import time as _time
 
+    recovery.syncpoint("exec_start")
     task_id = TaskID(task["task_id"])
     dreply = task.pop("_dreply", None)
     rt.current_task_id = task_id
@@ -1080,6 +1182,10 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
             dreply[0].reply(dreply[1], True, returns, meta)
         else:
             rt.send_result((task["task_id"], True, returns, {}))
+        if "actor_id" in task:
+            # After the reply (off the caller's latency path): persist
+            # __ray_save__ state for restartable actors.
+            rt.maybe_checkpoint_actor(task["actor_id"], actor)
     except Exception as e:  # noqa: BLE001 — task errors become objects
         err = exc.TaskError.from_exception(name, e)
         payload = _pickle_error(err)
@@ -1283,6 +1389,11 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
     """Worker runtime setup + execution loop (reference:
     core_worker.cc:2413 RunTaskExecutionLoop)."""
     os.environ.update(env)
+    # Opt-in chaos rules (RAY_TPU_CHAOS): deterministic self-kills at
+    # named syncpoints — armed before anything else so boot-path points
+    # fire too.  No-op (and zero steady-state cost) when the var is
+    # unset.
+    recovery.maybe_arm_env_chaos("worker")
     global _runtime
     send_lock = threading.Lock()
     # Workers pool freed segments too (the driver routes "free_segment" back
@@ -1481,6 +1592,19 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                     k: rt.materialize(d) for k, d in spec["kwargs"].items()
                 }
                 actor = cls(*args, **kwargs)
+                ck = spec.get("checkpoint")
+                if ck is not None and hasattr(actor, "__ray_restore__"):
+                    # Restart with retained state: __init__ ran fresh
+                    # above, then the last __ray_save__ state restores
+                    # over it.  A broken checkpoint degrades to the
+                    # fresh actor — it must never fail the restart
+                    # (that would turn recovery into the outage).
+                    try:
+                        actor.__ray_restore__(rt.materialize(ck))
+                    except Exception:
+                        traceback.print_exc()
+                rt.arm_actor_checkpoint(spec["actor_id"], actor,
+                                        spec.get("checkpoint_interval"))
                 actors[spec["actor_id"]] = actor
                 rt._send(("result", spec["task_id"], True,
                           [(protocol.INLINE,

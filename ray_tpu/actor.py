@@ -248,6 +248,10 @@ class ActorClass:
         serialize_args(rt, args, kwargs, spec)
         creation_opts = {
             "max_restarts": opts.get("max_restarts", 0),
+            # In-flight/queued method calls on a restarting actor are
+            # replayed up to this many times each (0 = fail them with
+            # ActorDiedError, the legacy behavior; -1 = unlimited).
+            "max_task_retries": opts.get("max_task_retries", 0),
             "max_concurrency": opts.get("max_concurrency", 1),
             "name": opts.get("name"),
             "namespace": opts.get("namespace", "default"),

@@ -1,0 +1,266 @@
+"""Chaos-injection harness: kill workers/agents/connections on schedule
+or at named syncpoints.
+
+Reference analog: ``python/ray/_private/test_utils.py`` ``kill_raylet``/
+``NodeKillerActor`` + the chaos-testing release jobs (``ray/release/
+chaos_test``) — fault tolerance that is not exercised does not exist.
+
+Opt-in twice over: nothing in this module runs unless (a) a test/driver
+constructs a :class:`ChaosController`, or (b) ``RAY_TPU_CHAOS`` env
+rules arm a spawned worker/agent process for deterministic self-kills
+(see ``recovery.maybe_arm_env_chaos``; grammar ``role:point:n`` — e.g.
+``worker:pull_chunk:3`` hard-kills the first worker to receive its 3rd
+pull chunk).  Steady-state cost with chaos off is one module-global
+``is None`` check per syncpoint.
+
+Driver-side controller::
+
+    chaos = ChaosController(rt)
+    chaos.schedule(0.5, chaos.kill_worker)      # wall-clock schedule
+    chaos.at_syncpoint("dispatch", chaos.kill_agent, n=10)
+    ...
+    chaos.stop()
+
+Every kill increments the runtime's ``chaos_kills`` counter
+(``transfer_stats()``), so tests can assert the injected faults actually
+happened — a chaos test whose kill silently missed proves nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private import recovery
+
+# Re-export: framework code fires syncpoints through recovery (no import
+# cycle); tests and user code may import them from here.
+syncpoint = recovery.syncpoint
+parse_chaos_rules = recovery.parse_chaos_rules
+
+
+def enabled() -> bool:
+    """Whether env-driven chaos is requested (``RAY_TPU_CHAOS`` set)."""
+    return bool(os.environ.get("RAY_TPU_CHAOS"))
+
+
+class ChaosController:
+    """Drives fault injection against one driver runtime.
+
+    Kill primitives take the runtime lock only long enough to pick a
+    victim and bump ``chaos_kills``; the actual kill (SIGKILL / conn
+    close) runs outside it.  Syncpoint-triggered actions execute on a
+    dedicated thread — the firing site may hold framework locks, and a
+    kill that re-enters the runtime from under them would deadlock."""
+
+    def __init__(self, rt=None, arm_syncpoints: bool = True):
+        if rt is None:
+            from ray_tpu._private.api_internal import require_runtime
+
+            rt = require_runtime()
+        self._rt = rt
+        self._lock = threading.Lock()
+        self._timers: List[threading.Timer] = []
+        # name -> list of [countdown, action, args] triples
+        self._sync_actions: Dict[str, List[list]] = {}
+        self._pending: List[tuple] = []
+        self._pending_ev = threading.Event()
+        self._stopped = False
+        self._runner = threading.Thread(target=self._run_loop, daemon=True,
+                                        name="ray_tpu-chaos")
+        self._runner.start()
+        if arm_syncpoints:
+            recovery.set_chaos_hook(self._fire)
+
+    # ------------------------------------------------------ scheduling --
+    def schedule(self, delay_s: float, action: Callable, *args, **kwargs):
+        """Run ``action`` after ``delay_s`` wall-clock seconds."""
+        t = threading.Timer(delay_s,
+                            lambda: self._enqueue(action, args, kwargs))
+        t.daemon = True
+        with self._lock:
+            self._timers.append(t)
+        t.start()
+        return t
+
+    def at_syncpoint(self, name: str, action: Callable, *args,
+                     n: int = 1, **kwargs):
+        """Run ``action`` when syncpoint ``name`` fires for the n-th
+        time (counted from registration)."""
+        with self._lock:
+            self._sync_actions.setdefault(name, []).append(
+                [max(1, n), action, args, kwargs])
+
+    def _fire(self, name: str, _info: dict):
+        todo = []
+        with self._lock:
+            lst = self._sync_actions.get(name)
+            if not lst:
+                return
+            for item in list(lst):
+                item[0] -= 1
+                if item[0] <= 0:
+                    lst.remove(item)
+                    todo.append(item)
+        for _n, action, args, kwargs in todo:
+            self._enqueue(action, args, kwargs)
+
+    def _enqueue(self, action, args, kwargs):
+        with self._lock:
+            if self._stopped:
+                return
+            self._pending.append((action, args, kwargs))
+        self._pending_ev.set()
+
+    def _run_loop(self):
+        while not self._stopped:
+            self._pending_ev.wait()
+            self._pending_ev.clear()
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        break
+                    action, args, kwargs = self._pending.pop(0)
+                try:
+                    action(*args, **kwargs)
+                except Exception:
+                    pass  # a missed kill must not crash the harness
+
+    # ------------------------------------------------------------ kills --
+    def _count_kill(self):
+        with self._rt.lock:
+            self._rt.chaos_kills += 1
+
+    def kill_worker(self, node_id: Optional[str] = None,
+                    actor: Optional[bool] = None,
+                    mid_task: bool = True) -> Optional[str]:
+        """SIGKILL one worker process.  ``node_id`` scopes the pick to a
+        node (hex); ``actor`` True/False filters actor vs plain workers;
+        ``mid_task`` prefers a worker with in-flight work (the
+        interesting case).  Returns the victim's worker id hex, or None
+        when nothing matched."""
+        victim = None
+        with self._rt.lock:
+            candidates = []
+            for node in self._rt.nodes.values():
+                if node_id is not None and node.node_id.hex() != node_id:
+                    continue
+                for w in node.all_workers.values():
+                    if w.dead:
+                        continue
+                    if actor is True and w.actor_id is None:
+                        continue
+                    if actor is False and w.actor_id is not None:
+                        continue
+                    busy = bool(w.inflight) or (
+                        w.actor_id is not None and w.conn is not None)
+                    candidates.append((busy, w))
+            for busy, w in candidates:
+                if busy or not mid_task:
+                    victim = w
+                    break
+            if victim is None:
+                return None
+            self._rt.chaos_kills += 1
+        wid = victim.worker_id.hex()
+        if victim.proc is not None:
+            try:
+                victim.proc.kill()
+            except Exception:
+                pass
+        else:
+            agent = (victim.node.agent
+                     if victim.node is not None else None)
+            if agent is not None and not agent.dead:
+                try:
+                    agent.send(("kill_worker_hard", wid))
+                except Exception:
+                    pass
+        return wid
+
+    def kill_agent(self, node_id: Optional[str] = None) -> Optional[str]:
+        """SIGKILL a node agent process (no graceful shutdown — its
+        workers are orphaned exactly as on real node loss).  Returns the
+        node id hex, or None."""
+        target = None
+        with self._rt.lock:
+            for agent in self._rt._agents.values():
+                if agent.dead or agent.node is None:
+                    continue
+                if node_id is not None \
+                        and agent.node.node_id.hex() != node_id:
+                    continue
+                target = agent
+                break
+            if target is None:
+                return None
+            self._rt.chaos_kills += 1
+        pid = target.info.get("pid")
+        nid = target.node.node_id.hex()
+        if pid:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        # Don't wait for the conn EOF: drive death handling now, like
+        # remove_node does — chaos tests need deterministic discovery.
+        try:
+            target.conn.close()
+        except Exception:
+            pass
+        self._rt._on_agent_death(target)
+        return nid
+
+    def drop_worker_connection(self,
+                               worker_id: Optional[str] = None
+                               ) -> Optional[str]:
+        """Close a worker's control connection WITHOUT killing the
+        process — the half-death case (network partition): the head sees
+        EOF and reroutes; the orphan must exit on its own."""
+        victim = None
+        with self._rt.lock:
+            for node in self._rt.nodes.values():
+                for w in node.all_workers.values():
+                    if w.dead or w.conn is None:
+                        continue
+                    if worker_id is not None \
+                            and w.worker_id.hex() != worker_id:
+                        continue
+                    victim = w
+                    break
+                if victim is not None:
+                    break
+            if victim is None:
+                return None
+            self._rt.chaos_kills += 1
+        try:
+            victim.conn.close()
+        except Exception:
+            pass
+        return victim.worker_id.hex()
+
+    # ------------------------------------------------------------ admin --
+    def stats(self) -> Dict[str, int]:
+        with self._rt.lock:
+            return {"chaos_kills": self._rt.chaos_kills}
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            timers, self._timers = self._timers, []
+            self._sync_actions.clear()
+            self._pending.clear()
+        for t in timers:
+            t.cancel()
+        recovery.set_chaos_hook(None)
+        self._pending_ev.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.stop()
+        return False

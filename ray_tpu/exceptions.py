@@ -83,15 +83,69 @@ class OutOfMemoryError(WorkerCrashedError):
 
 class ObjectLostError(RayTpuError):
     """Object's value is unrecoverable (owner gone, store evicted and no
-    lineage)."""
+    lineage).
+
+    One constructor for every raise site, carrying structured fields the
+    recovery subsystem keys off (reference: the typed error-object
+    payloads of ``common.proto`` — OBJECT_UNRECONSTRUCTABLE and friends
+    carry the object/owner identity, not prose):
+
+    - ``object_id``: hex of the lost object (when known),
+    - ``owner``: who held its metadata ("driver", a worker id hex, ...),
+    - ``home``: last-known home store id of the segment,
+    - ``phase``: where the loss was observed ("get", "pull", "dispatch",
+      "relay", "recover", ...).
+
+    ``reconstructable`` is the class-level recovery gate: lineage MAY
+    rebuild plain lost objects; subclasses for freed objects and dead
+    owners opt out — recovery refuses those by type, not by message
+    text."""
+
+    reconstructable = True
+
+    def __init__(self, message: str | None = None, *,
+                 object_id: str | None = None, owner: str | None = None,
+                 home: str | None = None, phase: str | None = None):
+        self.object_id = object_id
+        self.owner = owner
+        self.home = home
+        self.phase = phase
+        super().__init__(message if message is not None else self._format())
+
+    def _format(self) -> str:
+        parts = [f"Object {self.object_id or '<unknown>'} is lost"]
+        detail = [f"{k}={v}" for k, v in (("phase", self.phase),
+                                          ("home", self.home),
+                                          ("owner", self.owner)) if v]
+        if detail:
+            parts.append(f" ({', '.join(detail)})")
+        parts.append("" if type(self) is not ObjectLostError
+                     else "; no lineage survives to reconstruct it")
+        return "".join(parts)
+
+    def __reduce__(self):
+        return (_rebuild_object_lost,
+                (type(self), self.args[0] if self.args else None,
+                 self.object_id, self.owner, self.home, self.phase))
+
+
+def _rebuild_object_lost(cls, message, object_id, owner, home, phase):
+    return cls(message, object_id=object_id, owner=owner, home=home,
+               phase=phase)
 
 
 class ObjectFreedError(ObjectLostError):
-    pass
+    """The object was explicitly freed / its last reference dropped —
+    never reconstructable (reference: OBJECT_FREED error type)."""
+
+    reconstructable = False
 
 
 class OwnerDiedError(ObjectLostError):
-    pass
+    """The object's owner process died; its metadata (and lineage) died
+    with it — never reconstructable (reference: OWNER_DIED)."""
+
+    reconstructable = False
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

@@ -209,6 +209,32 @@ class RemoteFunction:
             "runtime_env": opts.get("runtime_env"),
             "scheduling_strategy": self._strategy_cache,
         }
+        # max_retries budgets SYSTEM failures (worker/node death) only;
+        # application exceptions retry solely under this opt-in (True =
+        # any app error, or exception type(s) matched against the task
+        # error's cause) — reference: retry_exceptions on @ray.remote.
+        # Carried only when set so default specs stay lean; a bare
+        # class (the natural shorthand) normalizes to a one-element
+        # list, and anything else non-boolean must be iterable —
+        # silently ignoring a malformed opt-in would fail the user's
+        # task permanently with no hint the option never applied.
+        rexc = opts.get("retry_exceptions")
+        if rexc is not None:
+            if isinstance(rexc, type) and issubclass(rexc, BaseException):
+                rexc = [rexc]
+            elif isinstance(rexc, (list, tuple)):
+                bad = [t for t in rexc
+                       if not (isinstance(t, type)
+                               and issubclass(t, BaseException))]
+                if bad:
+                    raise TypeError(
+                        "retry_exceptions entries must be exception "
+                        f"types; got {bad!r}")
+            elif not isinstance(rexc, bool):
+                raise TypeError(
+                    "retry_exceptions must be True/False, an exception "
+                    f"type, or a list of exception types; got {rexc!r}")
+            spec["retry_exceptions"] = rexc
         serialize_args(rt, args, kwargs, spec)
         if payload is not None and rt.is_worker():
             spec["func_payload"] = payload

@@ -48,6 +48,20 @@ def ray_start_regular():
 
 
 @pytest.fixture
+def chaos_controller():
+    """Chaos-injection harness bound to the current runtime (list this
+    fixture AFTER the fixture that boots the runtime, e.g.
+    ``ray_start_regular``).  Arms the process's syncpoints for the
+    test's duration and disarms + cancels schedules on teardown, so the
+    whole battery can run under ``RAY_TPU_LOCKCHECK=1``."""
+    from ray_tpu.chaos import ChaosController
+
+    ctl = ChaosController()
+    yield ctl
+    ctl.stop()
+
+
+@pytest.fixture
 def ray_start_cluster():
     """Multi-node-on-one-host cluster handle (reference:
     ray_start_cluster / cluster_utils.Cluster)."""

@@ -327,6 +327,9 @@ def test_renewal_batches_one_message_per_n_pushes(monkeypatch):
     caller.shutdown()
 
 
+@pytest.mark.slow  # ~16s; revocation-on-node-death now has a faster
+# tier-1 rep in tests/test_chaos.py (kill-agent-mid-lease interplay),
+# and the renewal/TTL units above stay tier-1
 def test_lease_revocation_on_node_death_mid_push():
     """A node dies while a holder is pushing onto its leased workers:
     the head revokes the leases explicitly (lease_revocations counts

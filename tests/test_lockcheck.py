@@ -406,6 +406,47 @@ def test_streaming_stats_lock_convention(checker):
     checker.assert_acyclic()
 
 
+def test_lineage_table_lock_is_leaf(checker):
+    """recovery.LineageTable._lock's documented convention: an
+    independent LEAF.  Both owners take it while already holding their
+    big lock — the head's runtime lock (record in _submit_specs, release
+    in _maybe_free_locked) and every DirectCaller's ownership lock — and
+    the table runs NO callbacks and acquires NO lock under it (eviction
+    RETURNS entries for the caller to release at its own level).  The
+    recorded graph must show the incoming edge and zero outgoing
+    edges."""
+    import ray_tpu as ray
+    from ray_tpu._private import api_internal
+
+    ray.init(num_cpus=2, num_tpus=0)
+    try:
+        rt = api_internal.get_runtime()
+        assert isinstance(rt.lineage._lock, lockcheck._LockProxy)
+        assert rt.config.recovery
+
+        @ray.remote
+        def f(x):
+            return x + 1
+
+        refs = [f.remote(i) for i in range(8)]
+        assert ray.get(refs) == list(range(1, 9))
+        # Release path: dropping the refs drives lineage.release under
+        # the runtime lock (the recorded inward edge).
+        del refs
+        import gc
+
+        gc.collect()
+        time.sleep(0.2)
+        lineage_site = rt.lineage._lock._site
+    finally:
+        ray.shutdown()
+    edges = checker.edges()
+    assert edges.get(lineage_site, set()) == set(), (
+        f"a lock was acquired while holding the lineage-table lock: "
+        f"{edges.get(lineage_site)}")
+    checker.assert_acyclic()
+
+
 def test_shm_store_copy_pool_lock_convention(checker, monkeypatch,
                                              tmp_path):
     """shm_store's documented convention: the module copy-pool lock and

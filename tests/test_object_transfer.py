@@ -379,6 +379,8 @@ class _PacedConn:
         return getattr(self._conn, item)
 
 
+@pytest.mark.slow  # perf A/B (~5s); striped/pooled CORRECTNESS keeps its
+# tier-1 reps via the reassembly + concurrency tests above
 def test_four_concurrent_64mb_pulls_2x_over_serial(shm_store):
     """Acceptance micro: 4 concurrent 64 MB pulls from one peer over a
     paced link — the pooled + striped puller must show ≥2x aggregate
