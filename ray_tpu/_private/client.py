@@ -58,6 +58,11 @@ class ClientRuntime(_WorkerRuntime):
         # from the client_ack info dict — None against an old head (no
         # info element) keeps every put on the legacy path.
         self._head_put_info = None
+        # Failover re-dial target, set by client_connect (clients have
+        # no RAY_TPU_ADDRESS env; the worker-flavor _redial is
+        # overridden below).
+        self._address = None
+        self._authkey = b""
         # Buffered small ("put", ...)/("addref", ...) message pairs:
         # many tiny puts ride out as one pickle+write instead of one
         # each (PR 2's conflation envelope, applied to the put path).
@@ -142,10 +147,11 @@ class ClientRuntime(_WorkerRuntime):
     def flush_puts(self):
         # Drain under send_lock: a drained-but-unwritten batch here must
         # not let a concurrent _send (whose message may reference one of
-        # these puts) overtake it on the wire.
+        # these puts) overtake it on the wire.  _send_wire parks the
+        # batch across a head blip instead of raising.
         with self.send_lock:
             buf = self._drain_put_buffer()
-            protocol.send_batch(self.conn, buf)
+            self._send_wire(buf)
 
     def serialize_value(self, value, object_id: ObjectID):
         """By-value task args travel inline or as parts inside the spec —
@@ -166,7 +172,64 @@ class ClientRuntime(_WorkerRuntime):
         """Generic control request (cluster_info, jobs, state...)."""
         return self._request(builder)
 
+    # Client-side spellings of the head's introspection surface (the
+    # failover drill drives an external head purely through a client).
+    def list_nodes(self):
+        return self.request(lambda rid: ("cluster_info", rid))["nodes"]
+
+    def state_query(self, kind: str, **kwargs):
+        out = self.request(lambda rid: ("state_req", rid, kind, kwargs))
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def transfer_stats(self):
+        return self.state_query("transfer_stats")[0]
+
+    def dial(self, addr):
+        """Direct-plane dials (granted lease workers, actor channels)
+        use THIS session's authkey — the env fallback the worker-side
+        dial reads may hold a stale key from an earlier client session
+        in the same process (client_connect's setdefault), which would
+        silently break every lease adoption with an auth error."""
+        from multiprocessing.connection import Client as _Dial
+
+        conn = _Dial(tuple(addr), authkey=self._authkey)
+        protocol.enable_nodelay(conn)
+        return conn
+
+    # -- head failover (client flavor of the worker machinery) -------------
+    def _redial(self):
+        from multiprocessing.connection import Client as _Dial
+
+        conn = _Dial(protocol.parse_address(self._address),
+                     authkey=self._authkey)
+        protocol.enable_nodelay(conn)
+        return conn
+
+    def _re_handshake(self, conn):
+        """Clients re-enter through the client_ready handshake (which
+        refreshes the head's direct-put bootstrap), then re-register
+        in-band: held leases and delegated objects re-advertised so the
+        restarted head can reconcile them."""
+        protocol.send(conn, ("client_ready", os.urandom(16).hex()))
+        msg = protocol.recv(conn)
+        if msg[0] != "client_ack":
+            return None
+        info = msg[2] if len(msg) > 2 else {}
+        if isinstance(info, dict) and info.get("object_addr") \
+                and info.get("store_id"):
+            self._head_put_info = (info["store_id"],
+                                   info["object_addr"],
+                                   tuple(info.get("object_caps") or ()))
+        protocol.send(conn, ("reregister", {
+            "held_leases": self.direct.held_lease_ids(),
+            "objects": self.direct.reregister_exports(),
+        }))
+        return True
+
     def disconnect(self):
+        self._shutting_down = True  # the reader must exit, not re-dial
         try:
             self.flush_puts()
             self.flush_decrefs()
@@ -208,6 +271,8 @@ def client_connect(address: str, authkey: bytes,
     os.environ.setdefault("RAY_TPU_AUTHKEY", authkey.hex())
     shm = ShmStore(shm_dir=tempfile.mkdtemp(prefix="ray_tpu_client_"))
     rt = ClientRuntime(conn, threading.Lock(), shm, max_inline)
+    rt._address = address
+    rt._authkey = authkey
     # The puller dials remote object servers (including the head's own —
     # large results stream back directly instead of relaying through the
     # control-plane connection).  Hand it THIS cluster's authkey
@@ -257,10 +322,17 @@ def client_connect(address: str, authkey: bytes,
     def reader():
         while True:
             try:
-                m = protocol.recv(conn)
+                m = protocol.recv(rt.conn)
             except (EOFError, OSError, TypeError):
-                return
-            handle(m)
+                # Head gone.  Park in-flight calls and re-dial for the
+                # grace window (worker-flavor machinery, client-flavor
+                # handshake) — a head restart becomes a stall, not a
+                # dead session.  disconnect() sets _shutting_down so a
+                # deliberate close still exits here.
+                if not rt._reconnect_head():
+                    return
+            else:
+                handle(m)
 
     threading.Thread(target=reader, daemon=True,
                      name="ray_tpu-client-reader").start()

@@ -261,6 +261,37 @@ class Config:
     # head restarts so agents/clients can re-authenticate.
     authkey_hex: str = ""
 
+    # --- Head failover (reference: workers reconnecting across a GCS
+    # restart — gcs_rpc_server_reconnect_timeout_s /
+    # gcs_failover_worker_reconnect_timeout, ray_config_def.h:62 — plus
+    # per-owner metadata surviving the metadata server, Ownership
+    # NSDI'21). ---
+    # Master switch: on head-connection EOF, workers and clients PARK
+    # in-flight head calls, re-dial with backoff, and re-register
+    # (re-advertising owned objects, held leases, queued/running tasks,
+    # and actor incarnations); node agents keep their workers ALIVE and
+    # re-dial.  Off = today's behavior: a worker exits on head EOF and
+    # an agent tears its workers down, so a head death is an outage.
+    head_failover: bool = True
+    # How long a disconnected peer (worker/client/agent) keeps re-dialing
+    # the head before giving up — the failover grace window.  A peer that
+    # exhausts it behaves as with the switch off (worker exit / agent
+    # teardown); the head revokes whatever it was holding.
+    head_reconnect_grace_s: float = 20.0
+    # How long a RESTARTED head waits for restored nodes, leases, and
+    # actor incarnations to be re-claimed by reconnecting peers before
+    # reconciling the remainder: unclaimed leases are revoked (the PR 6
+    # path), unclaimed restored actors are re-created from their last
+    # __ray_save__ checkpoint, and unresolved blip-window objects fail
+    # as reconstruction candidates.
+    head_reregister_timeout_s: float = 10.0
+    # Node agents re-dial a restarted head instead of exiting ("0"
+    # disables — the previously-undocumented escape hatch, now paired
+    # with head_failover: with failover on a reconnecting agent keeps
+    # its workers; with it off it kills them first, the legacy
+    # behavior).
+    agent_reconnect: bool = True
+
     # --- OOM memory monitor (reference: src/ray/common/memory_monitor.h
     # + worker_killing_policy_group_by_owner.cc: kill the newest
     # retriable task's worker before the kernel OOM-killer takes the

@@ -1642,6 +1642,33 @@ class DirectCaller:
                         self._maybe_free_locked(ObjectID(b), ist)
         self._flush_outbound()
 
+    def held_lease_ids(self) -> List[str]:
+        """Worker ids of every live lease this process HOLDS — re-
+        advertised at re-register so a restarted head can re-bind the
+        lease table rows that survived it (the pushes themselves never
+        touched the head)."""
+        with self.lock:
+            return sorted({lease.worker_id
+                           for pool in self.pools.values()
+                           for lease in pool["leases"]
+                           if not lease.dead})
+
+    def reregister_exports(self) -> List[tuple]:
+        """Entries this owner DELEGATED to the (now restarted) head:
+        (oid_bin, ok, descr, nested) rows re-advertised at re-register
+        so head-routed consumers of our objects keep resolving.  PENDING
+        shells are skipped — their export_complete rides the parked
+        outbox replay."""
+        out = []
+        with self.lock:
+            for oid, st in self.owned.items():
+                if st.status != DELEGATED or st.descr is None:
+                    continue
+                out.append((oid.binary(),
+                            st.descr[0] != protocol.ERROR,
+                            st.descr, []))
+        return out
+
     def shutdown(self):
         self._stopped = True
         self._send_event.set()  # unblock the push sender's exit

@@ -163,18 +163,47 @@ class NodeAgent:
         with self.send_lock:
             protocol.send(self.conn, msg)
 
-    def connect(self):
+    def _failover_knob(self, env_name: str, cfg_key: str, default):
+        """Env wins when explicitly set (the per-node escape hatch);
+        else the head-pushed agent_ack config (so the head's
+        ``_system_config`` governs the whole cluster); else default."""
+        raw = os.environ.get(env_name)
+        if raw is not None:
+            if isinstance(default, bool):
+                return raw.lower() in ("1", "true", "yes")
+            return type(default)(raw)
+        return self.head_config.get(cfg_key, default)
+
+    def connect(self, reconnect: bool = False):
         addr = protocol.parse_address(self.head_address)
-        for attempt in range(40):
-            try:
-                self.conn = Client(addr, authkey=self.authkey)
-                protocol.enable_nodelay(self.conn)
-                break
-            except (ConnectionError, OSError):
-                time.sleep(0.1 * (attempt + 1))
+        if reconnect:
+            # Failover grace window: the head may take a while to
+            # restart; keep dialing until it expires.
+            grace = self._failover_knob("RAY_TPU_HEAD_RECONNECT_GRACE_S",
+                                        "head_reconnect_grace_s", 20.0)
+            deadline = time.time() + max(1.0, grace)
+            attempt = 0
+            while time.time() < deadline:
+                try:
+                    self.conn = Client(addr, authkey=self.authkey)
+                    protocol.enable_nodelay(self.conn)
+                    break
+                except (ConnectionError, OSError):
+                    attempt += 1
+                    time.sleep(min(1.0, 0.1 * (attempt + 1)))
+        else:
+            for attempt in range(40):
+                try:
+                    self.conn = Client(addr, authkey=self.authkey)
+                    protocol.enable_nodelay(self.conn)
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(0.1 * (attempt + 1))
         if self.conn is None:
             raise SystemExit("node agent: cannot reach head at "
                              + self.head_address)
+        prev_node = getattr(self, "node_id_hex", "")
+        prev_session = self.session
         self._send(("agent_ready", {
             "resources": self.resources,
             "labels": self.labels,
@@ -188,6 +217,12 @@ class NodeAgent:
             "object_caps": list(object_transfer.CAPS),
             "pid": os.getpid(),
             "hostname": os.uname().nodename,
+            # Failover re-registration: a restarted head re-binds this
+            # node under its OLD id (matched by store_id) so surviving
+            # workers' node identity stays valid.
+            "reconnect": bool(reconnect),
+            "node_id": prev_node,
+            "session": prev_session,
         }))
         msg = protocol.recv(self.conn)
         assert msg[0] == "agent_ack", msg
@@ -198,6 +233,17 @@ class NodeAgent:
         # empty (see _memory_monitor).
         self.head_config = msg[3] if len(msg) > 3 else {}
         self._handshake_done.set()
+        if reconnect and self.session == prev_session \
+                and self.node_id_hex == prev_node:
+            # Same session, same node: the restarted head restored our
+            # registration — keep the live store (and its capacity
+            # accounting) and the surviving workers exactly as they are.
+            return
+        if reconnect and self.workers:
+            # The head came back as a DIFFERENT cluster (no restore):
+            # our workers belong to a dead session — tear them down, as
+            # the pre-failover reconnect always did.
+            self._terminate_workers()
         # Store for read_segment + direct-put ingest.  Segments here are
         # otherwise created by this node's workers; the agent allocates
         # only put reservations — under the same NODE capacity the
@@ -225,8 +271,9 @@ class NodeAgent:
                 msg = protocol.recv(self.conn)
             except (EOFError, OSError):
                 # Head gone.  If it persists GCS state it may restart on
-                # the same port: kill our (orphaned) workers and re-dial
-                # for a grace period before giving the node up
+                # the same port: keep our workers ALIVE (head_failover —
+                # they park and re-register on their own conns) and
+                # re-dial for a grace period before giving the node up
                 # (reference: workers reconnecting across GCS restart,
                 # gcs_failover_worker_reconnect_timeout,
                 # ray_config_def.h:62).
@@ -259,12 +306,38 @@ class NodeAgent:
         self.shutdown()
 
     def _reconnect(self) -> bool:
-        if os.environ.get("RAY_TPU_AGENT_RECONNECT", "1") != "1":
+        if not self._failover_knob("RAY_TPU_AGENT_RECONNECT",
+                                   "agent_reconnect", True):
             return False
-        # The old session's workers hold dead head conns and stale
-        # state.  terminate -> wait -> kill, as in shutdown(): a TPU
-        # worker mid-computation takes seconds to die, and new workers
-        # must not race it for the chips.
+        keep = self._failover_knob("RAY_TPU_HEAD_FAILOVER",
+                                   "head_failover", True)
+        if not keep:
+            # Legacy reconnect: the old session's workers hold dead head
+            # conns and stale state — terminate before re-dialing.  With
+            # failover ON the workers stay ALIVE (they park and
+            # re-register on their own conns; worker PIDs survive the
+            # blip), and connect() tears them down only if the head
+            # comes back as a different cluster.
+            self._terminate_workers()
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        self.conn = None  # connect()'s retry-exhaustion guard needs this
+        try:
+            self.connect(reconnect=keep)
+            return True
+        except (SystemExit, Exception):
+            if keep:
+                # Grace exhausted with workers still up: fall through to
+                # shutdown(), which terminates them — the legacy outage.
+                pass
+            return False
+
+    def _terminate_workers(self):
+        """terminate -> wait -> kill, as in shutdown(): a TPU worker
+        mid-computation takes seconds to die, and new workers must not
+        race it for the chips."""
         for proc in self.workers.values():
             try:
                 proc.terminate()
@@ -280,16 +353,6 @@ class NodeAgent:
                 except Exception:
                     pass
         self.workers.clear()
-        try:
-            self.conn.close()
-        except Exception:
-            pass
-        self.conn = None  # connect()'s retry-exhaustion guard needs this
-        try:
-            self.connect()  # its internal retry loop is the grace window
-            return True
-        except (SystemExit, Exception):
-            return False
 
     def _node_store_bytes(self) -> int:
         """THIS node's store cap: the explicit env override, else 80% of
@@ -363,20 +426,7 @@ class NodeAgent:
         if self._stopped:
             return
         self._stopped = True
-        for proc in self.workers.values():
-            try:
-                proc.terminate()
-            except Exception:
-                pass
-        deadline = time.time() + 3.0
-        for proc in self.workers.values():
-            try:
-                proc.wait(timeout=max(0.1, deadline - time.time()))
-            except Exception:
-                try:
-                    proc.kill()
-                except Exception:
-                    pass
+        self._terminate_workers()
         try:
             self.conn.close()
         except Exception:

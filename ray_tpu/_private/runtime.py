@@ -417,7 +417,19 @@ class Runtime:
     def __init__(self, config: Config, num_cpus=None, num_tpus=None,
                  resources=None, job_name="default"):
         self.config = config
-        self.session_id = os.urandom(4).hex()
+        # Failover restore peek: the snapshot is read EARLY (before the
+        # store/listeners exist) because a restarted head must ADOPT the
+        # dead head's session id — shm segment names are
+        # ``rtpu-<session>-<oid>`` and the worker rendezvous socket dir
+        # is keyed by session, so a fresh session id would orphan every
+        # surviving segment and strand reconnecting head-local workers.
+        self._restore_data = None
+        if config.gcs_restore and config.gcs_snapshot_path \
+                and os.path.exists(config.gcs_snapshot_path):
+            self._restore_data = self._load_snapshot(
+                config.gcs_snapshot_path)
+        self.session_id = ((self._restore_data or {}).get("session_id")
+                          or os.urandom(4).hex())
         self.job_id = JobID.from_random()
         self.job_name = job_name
         self.lock = threading.RLock()
@@ -530,10 +542,39 @@ class Runtime:
         self.reconstruction_failures = 0
         self.actor_restarts = 0
         self.chaos_kills = 0
+        # Head-failover counters (all zero while head_failover is off or
+        # no restart happened — pinned by tests): gcs_snapshots /
+        # gcs_snapshot_failures count the persistence loop's writes;
+        # reconnected_nodes = agents that re-dialed and re-claimed their
+        # restored node; reregistered_workers = surviving worker/client
+        # processes that re-registered across a head restart;
+        # adopted_actors = restored actor incarnations re-claimed by
+        # their surviving worker (state intact, no __init__ re-run).
+        self.gcs_snapshots = 0
+        self.gcs_snapshot_failures = 0
+        self.reconnected_nodes = 0
+        self.reregistered_workers = 0
+        self.adopted_actors = 0
+        # Reconcile state for a restarted head: restored-but-unclaimed
+        # nodes/actors/leases wait until _failover_grace_until for their
+        # surviving owners to re-register; the grace timer then revokes
+        # or re-creates the remainder.  _grace_objects tracks object ids
+        # a blip-window mget implicitly created (unknown to the restored
+        # tables) — still PENDING at the deadline, they fail as
+        # reconstruction candidates instead of waiting forever.
+        self._awaiting_nodes: Dict[str, NodeState] = {}  # store_id -> node
+        self._restored_actors: Dict[bytes, dict] = {}    # aid -> info
+        self._restored_leases: List[tuple] = []
+        self._pending_lease_claims: Dict[str, tuple] = {}
+        self._grace_objects: set = set()
+        self._failover_grace_until = 0.0
         # Identity of this process's object store: SHM descriptors carry it
         # so consumers know whether a segment is locally attachable or must
-        # be shipped (reference: owner-based object directory).
-        self.store_id = os.urandom(8).hex()
+        # be shipped (reference: owner-based object directory).  A
+        # restarted head adopts the dead head's store id too — restored
+        # descriptors homed "at the head" must keep resolving here.
+        self.store_id = ((self._restore_data or {}).get("store_id")
+                         or os.urandom(8).hex())
         self.spill_dir = (config.spill_dir
                           or f"/tmp/ray_tpu_spill_{self.session_id}")
         # Direct-put reservations degrade to the spill path (instead of
@@ -541,6 +582,12 @@ class Runtime:
         self.shm.spill_dir = self.spill_dir
         self._stopped = False
         self._extra_workers = 0
+        # Connection admission gate: the accept loops start mid-__init__
+        # but a RESTARTED head must not serve agent_ready / reregister
+        # until the snapshot restore populated the tables — an early
+        # reregister would be nacked (node not restored yet) and the
+        # surviving worker would exit instead of being adopted.
+        self._boot_ready = threading.Event()
 
         # Worker rendezvous: workers are plain subprocesses running
         # ``python -m ray_tpu._private.worker_main`` that dial back over a
@@ -551,9 +598,15 @@ class Runtime:
         self._authkey = (bytes.fromhex(config.authkey_hex)
                          if config.authkey_hex else os.urandom(16))
         self._puller._authkey = self._authkey
+        sock_path = os.path.join(self._sock_dir, "worker.sock")
+        try:
+            # An adopted session leaves the dead head's socket file
+            # behind; AF_UNIX bind fails on an existing path.
+            os.unlink(sock_path)
+        except OSError:
+            pass
         self._listener = multiprocessing.connection.Listener(
-            os.path.join(self._sock_dir, "worker.sock"), "AF_UNIX",
-            backlog=512, authkey=self._authkey)
+            sock_path, "AF_UNIX", backlog=512, authkey=self._authkey)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, args=(self._listener,), daemon=True,
             name="ray_tpu-accept")
@@ -597,8 +650,11 @@ class Runtime:
         if resources:
             head_resources.update(resources)
         head_resources.setdefault("memory", float(2 ** 33))
-        self.head_node = self._add_node_locked(head_resources,
-                                               labels={"head": "1"})
+        restored_head_id = (self._restore_data or {}).get("head_node_id")
+        self.head_node = self._add_node_locked(
+            head_resources, labels={"head": "1"},
+            node_id=(NodeID(bytes.fromhex(restored_head_id))
+                     if restored_head_id else None))
 
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True, name="ray_tpu-reaper")
@@ -664,12 +720,22 @@ class Runtime:
         # dispatch machinery is up — it re-creates named actors.
         self._gcs_dirty = 0
         self._gcs_snapshotted = 0
-        if config.gcs_restore and config.gcs_snapshot_path \
-                and os.path.exists(config.gcs_snapshot_path):
-            self._restore_gcs(config.gcs_snapshot_path)
+        self._gcs_stop = threading.Event()
+        # Serializes snapshot writes: shutdown()'s final clean snapshot
+        # must not interleave with an in-flight periodic write (both use
+        # the same pid-keyed tmp file — concurrent writers would tear
+        # it, and a stale periodic os.replace landing AFTER the clean
+        # one would un-mark the shutdown).
+        self._gcs_write_lock = threading.Lock()
+        # Object-row cache for huge tables (see _snapshot_gcs).
+        self._snap_obj_cache = None
+        if self._restore_data is not None:
+            self._apply_restore(self._restore_data)
+            self._restore_data = None
         if config.gcs_snapshot_path:
             threading.Thread(target=self._gcs_snapshot_loop, daemon=True,
                              name="ray_tpu-gcs-snap").start()
+        self._boot_ready.set()  # admission gate open: tables restored
         atexit.register(self.shutdown)
 
     def _task_sender_loop(self):
@@ -739,9 +805,12 @@ class Runtime:
 
     # ------------------------------------------------------------- nodes --
     def _add_node_locked(self, resources, labels=None, agent=None,
-                         store_id=None) -> NodeState:
-        node = NodeState(NodeID.from_random(), resources, labels,
-                         agent=agent,
+                         store_id=None, node_id=None) -> NodeState:
+        # node_id override: a restarted head re-creates restored nodes
+        # (its own included) under their OLD ids, so surviving workers'
+        # RAY_TPU_NODE_ID and node-affinity strategies stay valid.
+        node = NodeState(node_id or NodeID.from_random(), resources,
+                         labels, agent=agent,
                          store_id=(self.store_id if store_id is None
                                    else store_id))
         self.nodes[node.node_id] = node
@@ -1128,6 +1197,7 @@ class Runtime:
         window would strand the ref forever."""
         st.status = READY if ok else ERRORED
         st.descr = descr
+        self._gcs_dirty += 1  # object table rides the GCS snapshot now
         futures, st.futures = st.futures, []
         waiters, st.waiters = st.waiters, []
         for f in futures:
@@ -1143,6 +1213,7 @@ class Runtime:
             st = self.objects[oid] = ObjectState()
         st.status = READY if ok else ERRORED
         st.descr = descr
+        self._gcs_dirty += 1  # object table rides the GCS snapshot now
         if creator is not None and descr is not None \
                 and descr[0] == protocol.SHM:
             st.creator = creator
@@ -1896,6 +1967,10 @@ class Runtime:
         dispatch: a submit only needs its own class scanned — nothing it
         did could unblock another class); None scans every class
         (resource-release events, where anything may now place)."""
+        # Chaos syncpoint: a RAY_TPU_CHAOS "head:dispatch:N" rule takes
+        # the head down deterministically mid-scheduling (no-op unless
+        # the head process armed it — see _private/head_main.py).
+        recovery.syncpoint("dispatch")
         if self._stopped:
             return
         if self.pending_pgs:
@@ -2218,6 +2293,15 @@ class Runtime:
                 str(self.config.lineage_bytes_budget),
             "RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S":
                 str(self.config.actor_checkpoint_interval_s),
+            # Head-failover knobs: workers park + re-dial + re-register
+            # across a head restart (the switch and both windows are
+            # read in the worker process).
+            "RAY_TPU_HEAD_FAILOVER":
+                "1" if self.config.head_failover else "0",
+            "RAY_TPU_HEAD_RECONNECT_GRACE_S":
+                str(self.config.head_reconnect_grace_s),
+            "RAY_TPU_HEAD_REREGISTER_TIMEOUT_S":
+                str(self.config.head_reregister_timeout_s),
         }
 
     def _spawn_worker(self, node: NodeState, env_key: str,
@@ -2270,6 +2354,7 @@ class Runtime:
         env.update(self._worker_config_env())
         env.update({
             "RAY_TPU_WORKER_ID": worker_id.hex(),
+            "RAY_TPU_ENV_KEY": env_key,
             "RAY_TPU_ADDRESS": self._listener.address,
             "RAY_TPU_AUTHKEY": self._authkey.hex(),
             "RAY_TPU_SESSION": self.session_id,
@@ -2325,6 +2410,7 @@ class Runtime:
         overrides.update(self._worker_config_env())
         overrides.update({
             "RAY_TPU_WORKER_ID": worker_id.hex(),
+            "RAY_TPU_ENV_KEY": env_key,
             "RAY_TPU_ADDRESS": self.tcp_address,
             "RAY_TPU_AUTHKEY": self._authkey.hex(),
             "RAY_TPU_SESSION": self.session_id,
@@ -2368,8 +2454,20 @@ class Runtime:
                 msg = protocol.recv(conn)
             except (EOFError, OSError):
                 continue
+            # Admission waits for __init__ (incl. snapshot restore) to
+            # finish: reconnecting peers race a restarting head's boot.
+            self._boot_ready.wait(timeout=60)
             if msg[0] == "agent_ready":
                 self._register_agent(conn, msg[1])
+                continue
+            if msg[0] == "reregister":
+                # A surviving worker of the previous head incarnation
+                # re-dialed after our restart: re-admit it under its old
+                # identity and reconcile what it re-advertises (held
+                # leases, queued/running tasks, owned objects, its actor
+                # incarnation).  Reference: workers reconnecting across
+                # GCS restart, gcs_failover_worker_reconnect_timeout.
+                self._handle_worker_reregister(conn, msg[1])
                 continue
             if msg[0] == "client_ready":
                 # External process attaching in client mode (reference:
@@ -2431,10 +2529,29 @@ class Runtime:
         resources = dict(info.get("resources") or {"CPU": 1.0})
         resources.setdefault("memory", float(2 ** 33))
         with self.lock:
-            node = self._add_node_locked(resources,
-                                         labels=info.get("labels"),
-                                         agent=agent,
-                                         store_id=info["store_id"])
+            node = None
+            if info.get("reconnect"):
+                # Agent of a previous head incarnation re-dialing after
+                # our restart: re-claim its restored node under the OLD
+                # id so its surviving workers' node identity stays
+                # valid.  available is NOT reset — adopted actors may
+                # have acquired their slots before the agent returned.
+                self._awaiting_nodes.pop(info["store_id"], None)
+                for cand in self.nodes.values():
+                    if cand.store_id == info["store_id"] \
+                            and cand.agent is None \
+                            and cand is not self.head_node:
+                        node = cand
+                        break
+                if node is not None:
+                    node.alive = True
+                    node.agent = agent
+                    self.reconnected_nodes += 1
+            if node is None:
+                node = self._add_node_locked(resources,
+                                             labels=info.get("labels"),
+                                             agent=agent,
+                                             store_id=info["store_id"])
             agent.node = node
             self._agents[agent.store_id] = agent
             self._conn_to_agent[conn] = agent
@@ -2452,11 +2569,230 @@ class Runtime:
                   "memory_monitor_interval_s":
                       self.config.memory_monitor_interval_s,
                   "memory_monitor_test_file":
-                      self.config.memory_monitor_test_file}))
+                      self.config.memory_monitor_test_file,
+                  # Failover knobs the agent mirrors (its own env wins
+                  # when explicitly set — the per-node escape hatch):
+                  # keep-workers vs legacy teardown on head EOF, and
+                  # the re-dial grace window.
+                  "head_failover": self.config.head_failover,
+                  "head_reconnect_grace_s":
+                      self.config.head_reconnect_grace_s,
+                  "agent_reconnect": self.config.agent_reconnect}))
         threading.Thread(target=self._agent_reader, args=(conn, agent),
                          daemon=True, name="ray_tpu-rx-agent").start()
         with self.lock:
             self._dispatch_locked()
+
+    def _handle_worker_reregister(self, conn, info: dict):
+        """A worker process that survived the previous head's death
+        re-dialed: re-admit it under its OLD identity (worker id, node,
+        env key — the process, its store segments, and its direct-push
+        endpoint are all still live) and reconcile its claims."""
+        worker_hex = info.get("worker_id", "")
+        node_hex = info.get("node_id", "")
+        with self.lock:
+            node = self._node_by_hex_locked(node_hex)
+            refused = node is None or not self.config.head_failover
+        if refused:
+            # Unknown node (fresh head, no snapshot) or duplicate:
+            # refuse — the worker exits, which is the pre-failover
+            # behavior and the correct one for a cluster that did not
+            # restore.  (Outside the lock: nobody holds this conn yet.)
+            try:
+                protocol.send(conn, ("reregister_nack",))
+            except Exception:
+                pass
+            try:
+                conn.close()
+            except Exception:
+                pass
+            return
+        try:
+            w = WorkerHandle(
+                WorkerID(bytes.fromhex(worker_hex)), None, None,
+                node, info.get("env_key") or "default",
+                list(info.get("tpu_chips") or []))
+        except ValueError:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            return
+        w.lease_caps = True
+        if info.get("direct_addr"):
+            w.direct_addr = info["direct_addr"]
+        with self.lock:
+            stale = self._workers_by_hex.get(worker_hex)
+            if stale is not None and not stale.dead:
+                # The SAME process re-dialing again: its previous
+                # reregister was accepted but the ack never arrived
+                # (conn broke in the window).  The retry supersedes the
+                # stale handle — nacking it would exit a live worker
+                # the head believes it adopted.  Detach the stale handle
+                # without the death path (nothing died), transfer its
+                # claims, and re-park its actor for re-adoption below.
+                stale.dead = True
+                self._conn_to_worker.pop(stale.conn, None)
+                stale.node.all_workers.pop(id(stale), None)
+                for lst in stale.node.idle_workers.values():
+                    if stale in lst:
+                        lst.remove(stale)
+                try:
+                    stale.conn.close()
+                except Exception:
+                    pass
+                if stale.lease_req is not None and not stale.released:
+                    stale.node.release(stale.lease_req)
+                stale.lease_req = None
+                if stale.actor_id is not None:
+                    actor = self.actors.get(stale.actor_id)
+                    if actor is not None and actor.worker is stale:
+                        actor.worker = None
+                        actor.status = RESTARTING
+                        self._restored_actors.setdefault(
+                            stale.actor_id, {})
+                for tid_bin, rec in stale.inflight.items():
+                    rec.worker = w
+                    w.inflight[tid_bin] = rec
+                stale.inflight.clear()
+            # Ack straight on the conn BEFORE attach: registration under
+            # the lock means another thread may send through the handle
+            # the moment it lands in the tables — the ack must be first
+            # on the wire (the worker recv()s it inline).
+            try:
+                protocol.send(conn, ("reregister_ack", self.session_id))  # noqa: RTL402 -- one-time handshake; the ack must beat any locked send onto this conn
+            except Exception:
+                return
+            w.attach(conn)
+            w.ready.set()
+            self._conn_to_worker[conn] = w
+            self._workers_by_hex[worker_hex] = w
+            node.all_workers[id(w)] = w
+            self.reregistered_workers += 1
+            self._apply_reregister_claims_locked(w, info)
+            if not w.inflight and w.actor_id is None \
+                    and w.client_lease is None:
+                w.idle_since = time.monotonic()
+                node.idle_workers.setdefault(w.env_key, []).append(w)
+        threading.Thread(target=self._worker_reader, args=(conn, w),
+                         daemon=True, name="ray_tpu-rx").start()
+        with self.lock:
+            self._dispatch_locked()
+
+    def _apply_reregister_claims_locked(self, w: WorkerHandle,
+                                        info: dict):
+        """Reconcile one re-registration's claims against the restored
+        tables: the actor incarnation it hosts, the owned objects it
+        re-advertises, its queued/running head-dispatched tasks, and the
+        peer leases it holds."""
+        aid = info.get("actor_id")
+        if aid:
+            actor = self.actors.get(aid)
+            # Adoption only while the actor is still PARKED: once a cold
+            # restore claimed it (popped from _restored_actors), this
+            # surviving incarnation is stale — adopting it too would
+            # split the actor across two workers.
+            if actor is not None and aid in self._restored_actors \
+                    and actor.worker is None and actor.status != DEAD:
+                # Adoption: the incarnation (and its in-memory state)
+                # survived — no __init__ re-run, no checkpoint restore.
+                actor.status = ALIVE
+                actor.worker = w
+                actor.node = w.node
+                w.actor_id = aid
+                req = actor.options.get("resources") or {"CPU": 1.0}
+                w.lease_req = dict(req)
+                w.node.acquire(req)
+                if not actor.created_future.done():
+                    actor.created_future.set_result(True)
+                self._restored_actors.pop(aid, None)
+                self.adopted_actors += 1
+                self._gcs_dirty += 1
+                self._pump_actor_locked(actor)
+            elif actor is None:
+                # Created after the last snapshot: adopt a minimal
+                # record so addressing/kill/death paths keep working.
+                actor = ActorState(aid)
+                actor.status = ALIVE
+                actor.worker = w
+                actor.node = w.node
+                req = dict(info.get("resources") or {"CPU": 1.0})
+                actor.options = {"resources": req}
+                actor.created_future.set_result(True)
+                self.actors[aid] = actor
+                w.actor_id = aid
+                w.lease_req = dict(req)
+                w.node.acquire(req)
+                self.adopted_actors += 1
+        for item in info.get("objects", ()):
+            b, ok, descr, nested = item[0], item[1], item[2], item[3]
+            oid = ObjectID(b)
+            st = self.objects.get(oid)
+            if st is None:
+                st = self.objects[oid] = ObjectState()
+                st.pins = 1        # failover pin (restore semantics)
+                st.worker_refs = 1  # the exporter's aggregate ref
+            if st.status == PENDING and descr is not None:
+                self._complete_object_locked(oid, descr, bool(ok))
+            st.shipped = True
+        for t in info.get("tasks", ()):
+            tid_bin, num_returns, is_actor_task = t[0], t[1], t[2]
+            if tid_bin in self.tasks:
+                continue
+            spec = {"task_id": tid_bin, "num_returns": num_returns,
+                    "name": "failover_readopted", "resources": {},
+                    "args": [], "kwargs": {}}
+            rec = TaskRecord(spec, {}, 0)
+            rec.dispatched = True
+            rec.worker = w
+            rec.node = w.node
+            tid = TaskID(tid_bin)
+            for i in range(num_returns):
+                oid = tid.object_id(i)
+                if oid not in self.objects:
+                    self.objects[oid] = ObjectState(tid)
+            self.tasks[tid_bin] = rec
+            if is_actor_task and w.actor_id is not None:
+                actor = self.actors.get(w.actor_id)
+                if actor is not None:
+                    rec.actor_id = w.actor_id
+                    actor.inflight[tid_bin] = rec
+            else:
+                w.inflight[tid_bin] = rec
+        restored_req = {row[0]: row[2] for row in self._restored_leases}
+        now = time.monotonic()
+        ttl = self.config.lease_ttl_s
+        for wid in info.get("held_leases", ()):
+            lw = self._workers_by_hex.get(wid)
+            req = restored_req.get(wid) or {"CPU": 1.0}
+            if lw is not None and not lw.dead \
+                    and lw.client_lease is None and lw.actor_id is None:
+                lw.client_lease = w
+                lw.lease_req = dict(req)
+                lw.node.acquire(lw.lease_req)
+                lw.lease_expiry = (now + ttl) if ttl > 0 else None
+                # A worker that re-registered before its holder was
+                # pooled as idle; a leased worker must not be double-
+                # booked by head dispatch (the normal grant path pops
+                # it out of idle the same way).
+                for lst in lw.node.idle_workers.values():
+                    if lw in lst:
+                        lst.remove(lw)
+            else:
+                # The leased worker hasn't re-registered yet: park the
+                # claim; its own reregister consumes it below.
+                self._pending_lease_claims[wid] = (w.worker_id.hex(),
+                                                   req)
+        claim = self._pending_lease_claims.pop(
+            w.worker_id.hex(), None)
+        if claim is not None and w.actor_id is None and not w.inflight \
+                and w.client_lease is None:
+            holder = self._workers_by_hex.get(claim[0])
+            if holder is not None and not holder.dead:
+                w.client_lease = holder
+                w.lease_req = dict(claim[1] or {"CPU": 1.0})
+                w.node.acquire(w.lease_req)
+                w.lease_expiry = (now + ttl) if ttl > 0 else None
 
     # How long an unfulfillable client lease request is parked at the head
     # before an empty grant is returned (the caller then falls back to the
@@ -2934,20 +3270,55 @@ class Runtime:
     # --------------------------------------------- GCS snapshot/restore --
     def _gcs_snapshot_loop(self):
         while not self._stopped:
-            time.sleep(self.config.gcs_snapshot_interval_s)
+            # Wake on the stop event instead of sleeping out the full
+            # interval: shutdown() writes its final snapshot and must not
+            # race a stale periodic write (or wait interval_s to exit).
+            if self._gcs_stop.wait(self.config.gcs_snapshot_interval_s):
+                return
             if self._gcs_dirty != self._gcs_snapshotted:
                 try:
                     self._snapshot_gcs()
                 except Exception:
+                    with self.lock:
+                        self.gcs_snapshot_failures += 1
                     import traceback
 
                     traceback.print_exc()
 
-    def _snapshot_gcs(self):
-        """Atomically persist head metadata — the GCS tables a restarted
-        head needs: KV, function payloads, named-actor creation specs,
-        job records (reference: redis_store_client.h:28; the reference
-        persists the same table set for GCS failover)."""
+    def _snapshot_gcs(self, clean: bool = False):
+        """Atomically persist head metadata — the full GCS table set a
+        RESUMING cluster needs (reference: redis_store_client.h:28 table
+        persistence + GcsInitData load, gcs_server.h:77): KV, functions,
+        jobs, the OBJECT table (descriptor + home store — shm segments in
+        surviving agent stores outlive a head restart, and the adopted
+        session id keeps their ``rtpu-<session>-<oid>`` names valid), the
+        ACTOR table including retained ``__ray_save__`` checkpoint
+        descriptors, the client-lease table, and node registrations.
+
+        ``clean`` marks the final shutdown() snapshot: workers, agents,
+        and segments are about to be torn down with the session, so a
+        restore from it must NOT wait for re-registrations (nothing
+        survives to re-register) — it cold-restores immediately, which
+        is also what keeps the in-process snapshot->restore drill
+        deterministic."""
+        recovery.syncpoint("snapshot")
+        with self._gcs_write_lock:
+            # A periodic write that lost the race to shutdown's final
+            # clean snapshot must not replace it with a stale image.
+            if self._stopped and not clean:
+                return
+            self._snapshot_gcs_inner(clean)
+
+    # Object-row rebuild policy for huge tables: below the threshold
+    # every snapshot rebuilds the rows (exact); above it the O(#objects)
+    # scan under the runtime lock would stall dispatch every interval,
+    # so rows are reused for up to OBJ_REUSE_SNAPSHOTS writes — restore
+    # already tolerates row staleness (the blip-window grace machinery
+    # covers objects newer than the snapshot).
+    SNAP_OBJ_EXACT_MAX = 50_000
+    SNAP_OBJ_REUSE = 5
+
+    def _snapshot_gcs_inner(self, clean: bool):
         with self.lock:
             ver = self._gcs_dirty
             named = []
@@ -2955,8 +3326,8 @@ class Runtime:
                 a = self.actors.get(aid)
                 if a is None or a.status == DEAD:
                     continue
-                # Only inline init args survive a head restart (shm
-                # segments and refs of the dead session are meaningless).
+                # v1-compat list (old heads restore from it).  Only
+                # inline init args ship here.
                 args_ok = all(d[0] == protocol.INLINE
                               for d in (a.init_args or ()))
                 kwargs_ok = all(d[0] == protocol.INLINE
@@ -2971,10 +3342,75 @@ class Runtime:
                     "options": {k: v for k, v in a.options.items()
                                 if k != "scheduling_strategy"},
                 })
+            actors = []
+            for aid, a in self.actors.items():
+                if a.status == DEAD:
+                    continue
+                args_ok = all(
+                    d[0] == protocol.INLINE for d in (a.init_args or ())
+                ) and all(d[0] == protocol.INLINE
+                          for d in (a.init_kwargs or {}).values())
+                actors.append({
+                    "actor_id": aid,
+                    "name": a.name, "namespace": a.namespace,
+                    "func_id": a.func_id,
+                    "init_args": (list(a.init_args or ())
+                                  if args_ok else None),
+                    "init_kwargs": (dict(a.init_kwargs or {})
+                                    if args_ok else None),
+                    "options": {k: v for k, v in a.options.items()
+                                if k != "scheduling_strategy"},
+                    "restarts_left": a.restarts_left,
+                    "checkpoint": a.checkpoint,
+                    "home_store": (a.node.store_id
+                                   if a.node is not None else ""),
+                })
+            cache = self._snap_obj_cache
+            if (len(self.objects) <= self.SNAP_OBJ_EXACT_MAX or clean
+                    or cache is None or cache[0] <= 0):
+                objects = []
+                for oid, st in self.objects.items():
+                    if st.status != READY or st.descr is None:
+                        continue
+                    if st.descr[0] not in (protocol.INLINE, protocol.SHM,
+                                           protocol.SPILLED):
+                        continue
+                    objects.append((oid.binary(), st.descr,
+                                    list(st.nested_ids)))
+                self._snap_obj_cache = [self.SNAP_OBJ_REUSE, objects]
+            else:
+                cache[0] -= 1
+                objects = cache[1]
+            nodes = []
+            for node in self.nodes.values():
+                if node.agent is None or not node.alive:
+                    continue
+                nodes.append({
+                    "node_id": node.node_id.hex(),
+                    "resources": dict(node.resources),
+                    "labels": dict(node.labels),
+                    "store_id": node.store_id,
+                })
+            leases = []
+            for node in self.nodes.values():
+                for w in node.all_workers.values():
+                    if w.client_lease is not None and not w.dead:
+                        leases.append((w.worker_id.hex(),
+                                       w.client_lease.worker_id.hex(),
+                                       dict(w.lease_req or {})))
             data = {
+                "version": 2,
+                "clean": bool(clean),
+                "session_id": self.session_id,
+                "store_id": self.store_id,
+                "head_node_id": self.head_node.node_id.hex(),
                 "kv": {ns: dict(tbl) for ns, tbl in self.kv.items()},
                 "functions": dict(self.functions),
                 "named_actors": named,
+                "actors": actors,
+                "objects": objects,
+                "nodes": nodes,
+                "leases": leases,
                 "jobs": self._snapshot_jobs_locked(),
                 "tcp_address": self.tcp_address,
             }
@@ -2987,6 +3423,8 @@ class Runtime:
             os.fsync(f.fileno())  # torn snapshot = unrestartable head
         os.replace(tmp, path)
         self._gcs_snapshotted = ver
+        with self.lock:
+            self.gcs_snapshots += 1
 
     def _snapshot_jobs_locked(self):
         mgr = getattr(self, "_job_manager", None)
@@ -2996,43 +3434,249 @@ class Runtime:
         # snapshot written before first job use can't wipe job history.
         return list(getattr(self, "_restored_jobs", []) or [])
 
-    def _restore_gcs(self, path: str):
-        """Head restart: reload tables and re-create named actors from
-        their creation specs (reference: GcsInitData load + actor
-        restart-on-failover, gcs_server.h:77)."""
+    def _load_snapshot(self, path: str) -> Optional[dict]:
         try:
             with open(path, "rb") as f:
-                data = serialization.loads_inline(f.read())
+                return serialization.loads_inline(f.read())
         except Exception as e:  # noqa: BLE001
             # A corrupt snapshot must not make the head unstartable —
             # that is the exact failure this feature exists to survive.
             print(f"ray_tpu: GCS snapshot {path!r} unreadable ({e!r}); "
                   f"starting fresh")
-            return
+            return None
+
+    def _apply_restore(self, data: dict):
+        """Head restart: reload the persisted tables, then RECONCILE
+        against re-registrations instead of assuming the cluster died
+        with the old head (reference: GcsInitData load + workers
+        reconnecting across GCS restart, gcs_server.h:77).
+
+        - Restored agent NODES come back not-alive under their old ids;
+          a reconnecting agent re-claims its node by store id.  Nodes
+          that miss the grace window stay dead (their objects surface as
+          losses lazily, the PR 9 reconstruction candidates).
+        - Restored OBJECTS come back READY with a permanent failover pin
+          (pins=1): exact refcounts died with the old head, so the safe
+          direction is leak-until-shutdown, never free-early.
+        - Restored ACTORS wait for their surviving worker to re-claim
+          the incarnation (state intact); unclaimed ones are re-created
+          at the grace deadline from their creation spec, restoring the
+          last ``__ray_save__`` checkpoint over ``__init__``.
+        - Restored LEASES re-bind when both sides re-register; the
+          remainder is revoked through the PR 6 path at the deadline.
+        """
+        v2 = data.get("version", 1) >= 2
+        # Crash restores WAIT for surviving peers to re-register
+        # (adoption beats re-creation: state continuity is free).  A
+        # snapshot written by a CLEAN shutdown has nothing surviving it
+        # — its session's workers/agents/segments were torn down — so
+        # restore is immediate and SHM residue is skipped.  With the
+        # failover switch off, re-registration is refused anyway, so
+        # waiting would only delay the cold restores.
+        wait = (not data.get("clean")) and self.config.head_failover
         with self.lock:
             for ns, tbl in data.get("kv", {}).items():
                 self.kv.setdefault(ns, {}).update(tbl)
             self.functions.update(data.get("functions", {}))
+            for oid_bin, descr, nested in data.get("objects", []):
+                if data.get("clean") and descr[0] != protocol.INLINE:
+                    continue  # segments died with the clean shutdown
+                oid = ObjectID(oid_bin)
+                if oid in self.objects:
+                    continue
+                st = self.objects[oid] = ObjectState()
+                st.status = READY
+                st.descr = descr
+                st.pins = 1  # failover pin (see docstring)
+                st.nested_ids = list(nested)
+                st.shipped = True  # never pool a pre-blip segment
+            if not data.get("clean"):
+                for info in data.get("nodes", []):
+                    node = self._add_node_locked(
+                        info["resources"], labels=info.get("labels"),
+                        agent=None, store_id=info["store_id"],
+                        node_id=NodeID(bytes.fromhex(info["node_id"])))
+                    node.alive = False  # until its agent re-registers
+                    if wait:
+                        self._awaiting_nodes[info["store_id"]] = node
+            for info in data.get("actors", []):
+                if data.get("clean"):
+                    # The clean shutdown swept the session's segments
+                    # and spill dir: a retained checkpoint descriptor
+                    # points at deleted storage — drop it so the cold
+                    # restore goes straight to fresh __init__ instead
+                    # of a doomed __ray_restore__ attempt.
+                    info = dict(info, checkpoint=None)
+                self._restore_actor_locked(info)
+            self._restored_leases = (list(data.get("leases", []))
+                                     if wait else [])
         self._restored_jobs = data.get("jobs", [])
-        for info in data.get("named_actors", []):
-            spec = {
-                "task_id": new_task_id().binary(),
-                "func_id": info["func_id"],
-                "args": info["init_args"],
-                "kwargs": info["init_kwargs"],
-                "num_returns": 1,
-                "name": f"{info['name']}.__restore__",
-                "resources": (info["options"].get("resources")
-                              or {"CPU": 1.0}),
-            }
-            opts = dict(info["options"])
-            opts["name"] = info["name"]
-            opts["namespace"] = info["namespace"]
-            try:
-                self.create_actor(spec, opts)
-            except Exception as e:  # noqa: BLE001
-                print(f"ray_tpu: could not restore actor "
-                      f"{info['name']!r}: {e!r}")
+        if not v2:
+            # v1 snapshot: no actor table — fall back to re-creating the
+            # named actors from their inline creation specs.
+            for info in data.get("named_actors", []):
+                opts = dict(info["options"])
+                opts["name"] = info["name"]
+                opts["namespace"] = info["namespace"]
+                try:
+                    self.create_actor({
+                        "task_id": new_task_id().binary(),
+                        "func_id": info["func_id"],
+                        "args": info["init_args"],
+                        "kwargs": info["init_kwargs"],
+                        "num_returns": 1,
+                        "name": f"{info['name']}.__restore__",
+                        "resources": (opts.get("resources")
+                                      or {"CPU": 1.0}),
+                    }, opts)
+                except Exception as e:  # noqa: BLE001
+                    print(f"ray_tpu: could not restore actor "
+                          f"{info['name']!r}: {e!r}")
+        if wait and v2:
+            grace = self.config.head_reregister_timeout_s
+            self._failover_grace_until = time.monotonic() + grace
+            t = threading.Timer(grace, self._reconcile_failover)
+            t.daemon = True
+            t.start()
+        else:
+            # Nothing can (clean) or may (failover off) re-register:
+            # cold-restore every parked actor right now.
+            self._reconcile_actors(wait_for_adoption=False)
+
+    def _restore_actor_locked(self, info: dict):
+        """Rebuild one ActorState under its OLD id (surviving handles
+        and direct actor channels keep working) in RESTARTING state,
+        parked until its worker re-claims it or the grace timer re-
+        creates it."""
+        aid = info["actor_id"]
+        actor = ActorState(aid)
+        actor.func_id = info["func_id"]
+        actor.options = dict(info.get("options") or {})
+        actor.max_concurrency = actor.options.get("max_concurrency", 1)
+        actor.restarts_left = info.get("restarts_left", 0)
+        actor.name = info.get("name")
+        actor.namespace = info.get("namespace", "default")
+        actor.init_args = info.get("init_args")
+        actor.init_kwargs = info.get("init_kwargs")
+        actor.checkpoint = info.get("checkpoint")
+        actor.status = RESTARTING
+        actor.handle_count = 1  # conservative: a surviving handle may exist
+        self.actors[aid] = actor
+        if actor.name:
+            self.named_actors[(actor.namespace, actor.name)] = aid
+        self._restored_actors[aid] = info
+
+    def _reconcile_failover(self):
+        """Grace deadline: revoke/re-create everything no surviving peer
+        re-claimed (reference: gcs_failover_worker_reconnect_timeout)."""
+        lease_rows = []
+        with self.lock:
+            leases, self._restored_leases = self._restored_leases, []
+            for worker_hex, holder_hex, req in leases:
+                w = self._workers_by_hex.get(worker_hex)
+                holder = self._workers_by_hex.get(holder_hex)
+                if w is None or w.dead or w.client_lease is not None:
+                    continue  # never re-registered, or already re-bound
+                # Worker came back but its holder missed the window:
+                # revoke through the PR 6 path so the slot frees.
+                self.lease_revocations += 1
+                lease_rows.append((w, holder))
+            missed = {sid: n for sid, n in self._awaiting_nodes.items()
+                      if n.agent is None}
+            self._awaiting_nodes.clear()
+            for node in missed.values():
+                node.alive = False
+            # Implicit blip-window objects still PENDING with no task to
+            # produce them: fail as reconstruction candidates (recovery
+            # refuses without lineage — that surfaces the honest
+            # ObjectLostError instead of an eternal hang).
+            for oid_bin in list(self._grace_objects):
+                oid = ObjectID(oid_bin)
+                st = self.objects.get(oid)
+                if st is None or st.status != PENDING:
+                    continue
+                if self._try_recover_locked(oid):
+                    continue
+                err = (protocol.ERROR, serialization.dumps_inline(  # noqa: RTL402 -- cold once-per-failover path
+                    exc.ObjectLostError(
+                        object_id=oid.hex(), phase="head_failover")))
+                self._complete_object_locked(oid, err, False)
+            self._grace_objects.clear()
+        for w, holder in lease_rows:
+            if holder is not None and not holder.dead:
+                try:
+                    self._queue_send(holder, ("lease_revoke",
+                                              [w.worker_id.hex()]))
+                except Exception:
+                    pass
+        self._reconcile_actors(wait_for_adoption=False)
+        with self.lock:
+            self._dispatch_locked()
+
+    def _reconcile_actors(self, wait_for_adoption: bool):
+        """Re-create restored actors nobody re-claimed.  Adoption (the
+        surviving worker re-registering its incarnation) always beats
+        re-creation — state continuity is free — so a crash restore
+        leaves parked actors alone until the grace deadline calls back
+        in with ``wait_for_adoption=False``."""
+        if wait_for_adoption:
+            return
+        with self.lock:
+            todo = []
+            for aid, info in list(self._restored_actors.items()):
+                # Popping under the lock closes the adoption race: a
+                # reregister arriving after this pass sees the actor
+                # gone from _restored_actors and is refused — one
+                # incarnation, never two.
+                self._restored_actors.pop(aid, None)
+                actor = self.actors.get(aid)
+                if actor is None or actor.status != RESTARTING \
+                        or actor.worker is not None:
+                    continue
+                todo.append((actor, info))
+        for actor, info in todo:
+            self._cold_restore_actor(actor, info)
+
+    def _cold_restore_actor(self, actor: ActorState, info: dict):
+        """Re-run an unclaimed restored actor's creation spec under its
+        OLD id, restoring the retained ``__ray_save__`` checkpoint over
+        ``__init__`` (reference: actor restart on GCS failover +
+        checkpointable actors)."""
+        if actor.init_args is None:
+            # Non-inline creation args died with the old session and no
+            # surviving worker re-claimed the incarnation: the actor is
+            # honestly dead.
+            err = exc.ActorDiedError(
+                f"Actor {actor.actor_id.hex()} could not be restored "
+                f"across the head restart (non-inline creation args and "
+                f"no surviving incarnation)")
+            with self.lock:
+                actor.status = DEAD
+                actor.death_cause = err
+                self._gcs_dirty += 1
+                self._fail_actor_queue_locked(actor, err)
+            return
+        req = actor.options.get("resources") or {"CPU": 1.0}
+        spec = {
+            "task_id": new_task_id().binary(),
+            "func_id": actor.func_id,
+            "args": actor.init_args,
+            "kwargs": actor.init_kwargs or {},
+            "num_returns": 1,
+            "name": "actor.__failover_restore__",
+            "resources": req,
+        }
+        rec = TaskRecord(spec, req, 0)
+        rec.is_actor_creation = True
+        rec.actor_id = actor.actor_id
+        tid = TaskID(spec["task_id"])
+        with self.lock:
+            actor.restarts_left = info.get("restarts_left", 0)
+            self.objects[tid.object_id(0)] = ObjectState(tid)
+            self.tasks[spec["task_id"]] = rec
+            self._gcs_dirty += 1
+            self._enqueue_pending_locked(rec)
+            self._dispatch_locked()
 
     def _enqueue_actor_task_nopump_locked(
             self, rec: TaskRecord) -> Optional[bytes]:
@@ -4022,6 +4666,38 @@ class Runtime:
                         and not worker.dead and worker.actor_id is None:
                     self._end_lease_locked(worker)
                 self._request_dispatch_locked()
+        elif tag == "reregister":
+            # In-band re-registration from a CLIENT that re-dialed after
+            # a head restart (its conn-level handshake already ran via
+            # client_ready): reconcile its claims — held leases and
+            # re-advertised owned objects.  Gated like the worker path:
+            # with the failover switch off nothing reconciles and every
+            # failover counter stays zero (the client session itself
+            # still works — it re-entered through client_ready).
+            if self.config.head_failover:
+                with self.lock:
+                    self.reregistered_workers += 1
+                    self._apply_reregister_claims_locked(worker, msg[1])
+        elif tag == "resubmit_batch":
+            # Failover replay: specs whose fate at the dead head is
+            # unknown to the submitter.  At-least-once semantics (the
+            # reference's retry contract): skip anything already known
+            # or already completed, run the rest.
+            with self.lock:
+                fresh = []
+                for spec in msg[1]:
+                    tid_bin = spec["task_id"]
+                    if tid_bin in self.tasks:
+                        continue
+                    tid = TaskID(tid_bin)
+                    sts = [self.objects.get(tid.object_id(i))
+                           for i in range(max(1, spec["num_returns"]))]
+                    if all(s is not None and s.status != PENDING
+                           for s in sts):
+                        continue
+                    fresh.append(spec)
+            if fresh:
+                self.submit_tasks_from_worker(fresh, submitter=worker)
         elif tag == "actor_checkpoint":
             # Latest __ray_save__ state from a restartable actor's
             # worker: retain the descriptor for the next restart's
@@ -4086,6 +4762,18 @@ class Runtime:
                 pass
 
         with self.lock:
+            if time.monotonic() < self._failover_grace_until:
+                # Post-restart grace: an unknown id may belong to the
+                # blip window (task finished after the last snapshot, or
+                # still running on a worker that has not re-registered
+                # yet).  Park it as implicitly-PENDING instead of
+                # insta-failing; the reconcile timer fails the remainder
+                # as reconstruction candidates.
+                for b in id_bins:
+                    oid = ObjectID(b)
+                    if oid not in self.objects:
+                        self.objects[oid] = ObjectState()
+                        self._grace_objects.add(b)
             pend = [st for b in id_bins
                     if (st := self.objects.get(ObjectID(b))) is not None
                     and st.status == PENDING]
@@ -4151,6 +4839,18 @@ class Runtime:
         with self.lock:
             rec = self.tasks.pop(task_id_bin, None)
             if rec is None:
+                # No record, but PENDING return entries exist: a blip-
+                # window result (task finished while the head was down;
+                # the worker's outbox replayed it after re-register).
+                # Live retries keep their task record, so this can never
+                # swallow a result a retry now owns.
+                tid = TaskID(task_id_bin)
+                for i, descr in enumerate(returns):
+                    st = self.objects.get(tid.object_id(i))
+                    if st is not None and st.status == PENDING:
+                        self._complete_object_locked(
+                            tid.object_id(i), descr,
+                            descr[0] != protocol.ERROR, creator=worker)
                 return
             if (retry_err is not None and not rec.is_actor_creation
                     and rec.actor_id is None and not rec.cancelled
@@ -4767,6 +5467,16 @@ class Runtime:
         if self._stopped:
             return
         self._stopped = True
+        self._gcs_stop.set()  # wake the snapshot loop out of its wait
+        if self.config.gcs_snapshot_path:
+            # Final snapshot while the tables are still live: a clean
+            # shutdown must leave a restartable image even if the last
+            # periodic write raced this exit.
+            try:
+                self._snapshot_gcs(clean=True)
+            except Exception:
+                with self.lock:
+                    self.gcs_snapshot_failures += 1
         self._sender_event.set()  # unblock the conflation sender's exit
         self._dispatch_event.set()  # unblock the dispatcher's exit
         with self.lock:
@@ -5033,6 +5743,11 @@ class Runtime:
                 "reconstruction_failures": self.reconstruction_failures,
                 "actor_restarts": self.actor_restarts,
                 "chaos_kills": self.chaos_kills,
+                "gcs_snapshots": self.gcs_snapshots,
+                "gcs_snapshot_failures": self.gcs_snapshot_failures,
+                "reconnected_nodes": self.reconnected_nodes,
+                "reregistered_workers": self.reregistered_workers,
+                "adopted_actors": self.adopted_actors,
             }
 
     def list_nodes(self):

@@ -27,6 +27,7 @@ import itertools
 import os
 import queue
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -152,6 +153,36 @@ class _WorkerRuntime:
         # restart AND the class defines __ray_save__/__ray_restore__.
         self._actor_ck: Dict[bytes, dict] = {}
         self._actor_ck_lock = threading.Lock()
+        # --- Head failover (reference: workers reconnecting across GCS
+        # restart, gcs_failover_worker_reconnect_timeout).  On head-conn
+        # EOF this process PARKS instead of exiting: outgoing head
+        # messages buffer in _head_outbox (order preserved), in-flight
+        # head requests stay registered in ``pending`` and are replayed
+        # verbatim after the re-dial + re-register handshake.  All
+        # _conn_down/_head_outbox mutation happens under send_lock.
+        self._failover = os.environ.get("RAY_TPU_HEAD_FAILOVER",
+                                        "1") == "1"
+        self._reconnect_grace = float(os.environ.get(
+            "RAY_TPU_HEAD_RECONNECT_GRACE_S", "20") or 0)
+        self._conn_down = False
+        self._head_outbox: list = []
+        self._reconn_lock = threading.Lock()
+        self._shutting_down = False
+        self.head_reconnects = 0
+        # Head-routed PLAIN task specs retained until a return is
+        # materialized: their fate at a dead head is unknown, so the
+        # re-register replay re-offers them (the head skips any it
+        # already knows — at-least-once, the reference retry contract).
+        # Bounded FIFO; actor calls are excluded (replay would break
+        # per-channel ordering).
+        from collections import OrderedDict as _OD
+
+        self._inflight_head_specs: "_OD[bytes, dict]" = _OD()
+        self._spec_lock = threading.Lock()
+        # Hooks worker_entry fills in for the re-register payload.
+        self.snapshot_tasks = lambda: []
+        self.snapshot_actors = lambda: []
+        self._executing_tasks: list = []  # (task, is_direct) pairs
 
     # -- peer messaging (ring collectives etc.) ----------------------------
     def register_peer_handler(self, channel: str, fn):
@@ -177,7 +208,26 @@ class _WorkerRuntime:
         # decref-processing path itself; flushing would recurse into
         # send_lock).
         with self.send_lock:
-            protocol.send(self.conn, msg)
+            self._send_wire([msg])
+
+    def _send_wire(self, msgs: list):
+        """One batched write to the head — MUST be called under
+        send_lock.  On a broken head conn with failover on, the messages
+        PARK in _head_outbox (order preserved) for replay after the
+        reconnect instead of raising: every caller on this path is
+        fire-and-forget, and the reader thread drives the re-dial."""
+        if not msgs:
+            return
+        if self._conn_down:
+            self._head_outbox.extend(msgs)
+            return
+        try:
+            protocol.send_batch(self.conn, msgs)
+        except Exception:
+            if not self._failover or self._shutting_down:
+                raise
+            self._conn_down = True
+            self._head_outbox.extend(msgs)
 
     def dial(self, addr):
         from multiprocessing.connection import Client
@@ -195,6 +245,7 @@ class _WorkerRuntime:
         # Rerouted specs may carry owned refs: make them head-visible
         # first (same-conn FIFO puts the export before the spec).
         self._export_for_head_path(spec)
+        self._note_head_spec(spec)
         self._send(("submit", 0, spec))
 
     def submit_via_head_many(self, specs: list):
@@ -203,6 +254,7 @@ class _WorkerRuntime:
         FIFO) instead of a single-submit storm on the head."""
         for spec in specs:
             self._export_for_head_path(spec)
+            self._note_head_spec(spec)
         self._send(("submit_batch", specs))
 
     @property
@@ -266,7 +318,7 @@ class _WorkerRuntime:
             if abuf:
                 msgs.append(("actor_decref_batch", abuf))
             msgs.append(msg)
-            protocol.send_batch(self.conn, msgs)
+            self._send_wire(msgs)
 
     def send_result(self, entry):
         """Buffer one completed task's (task_id, ok, returns, meta);
@@ -336,7 +388,7 @@ class _WorkerRuntime:
                 msgs.append(("decref_batch", head_bins))
             if abuf:
                 msgs.append(("actor_decref_batch", abuf))
-            protocol.send_batch(self.conn, msgs)
+            self._send_wire(msgs)
 
     # Actor-handle refcounts (reference: actor out-of-scope GC) — the head
     # keeps the authoritative count; addref is sent inline (pickle-time,
@@ -379,9 +431,13 @@ class _WorkerRuntime:
     def _request(self, msg_builder):
         req_id = next(self.req_counter)
         slot: "queue.SimpleQueue" = queue.SimpleQueue()
+        msg = msg_builder(req_id)
         with self.pending_lock:
-            self.pending[req_id] = slot
-        self._send(msg_builder(req_id))
+            # The built message is retained alongside the slot: a head
+            # restart replays every still-pending request verbatim to
+            # the new incarnation (park-and-replay).
+            self.pending[req_id] = (slot, msg)
+        self._send(msg)
         reply = slot.get()
         with self.pending_lock:
             self.pending.pop(req_id, None)
@@ -389,9 +445,154 @@ class _WorkerRuntime:
 
     def deliver_reply(self, req_id, payload):
         with self.pending_lock:
-            slot = self.pending.get(req_id)
-        if slot is not None:
-            slot.put(payload)
+            ent = self.pending.get(req_id)
+        if ent is not None:
+            ent[0].put(payload)
+
+    # -- head failover: park, re-dial, re-register, replay -----------------
+    def _redial(self):
+        """One dial attempt to the head's listener; raises on refusal."""
+        from multiprocessing.connection import Client
+
+        addr = protocol.parse_address(os.environ["RAY_TPU_ADDRESS"])
+        conn = Client(addr, authkey=bytes.fromhex(
+            os.environ.get("RAY_TPU_AUTHKEY", "")))
+        protocol.enable_nodelay(conn)
+        return conn
+
+    def _re_handshake(self, conn):
+        """Re-register this surviving process with the (restarted) head.
+        True = re-admitted; False = permanently refused (nack — the head
+        did not restore our cluster); None = transient, retry."""
+        protocol.send(conn, ("reregister", self._reregister_info()))
+        msg = protocol.recv(conn)  # the ack is first on this conn (FIFO)
+        if msg[0] == "reregister_ack":
+            return True
+        if msg[0] == "reregister_nack":
+            return False
+        return None
+
+    def _reregister_info(self) -> dict:
+        """Everything the restarted head needs to reconcile us back in:
+        identity, the actor incarnation we host, our queued/running
+        head-dispatched tasks, re-advertised delegated objects, and the
+        peer leases we hold."""
+        hosted = list(self.snapshot_actors())
+        return {
+            "worker_id": self.worker_id_hex,
+            "node_id": self.node_id_hex,
+            "store_id": self.store_id,
+            "env_key": os.environ.get("RAY_TPU_ENV_KEY", ""),
+            "pid": os.getpid(),
+            "direct_addr": self.direct_addr,
+            "tpu_chips": list(self.tpu_chips),
+            "actor_id": (hosted[0] if hosted else None),
+            "resources": dict(self.assigned_resources),
+            "tasks": self.snapshot_tasks(),
+            "objects": self.direct.reregister_exports(),
+            "held_leases": self.direct.held_lease_ids(),
+        }
+
+    def _reconnect_head(self) -> bool:
+        """Reader-thread entry on head-conn EOF: re-dial with backoff
+        for the grace window, re-register, then replay pending requests
+        and the parked outbox.  False = give up (caller exits, the
+        pre-failover behavior)."""
+        if not self._failover or self._shutting_down:
+            return False
+        with self._reconn_lock:
+            with self.send_lock:
+                self._conn_down = True
+            deadline = time.monotonic() + self._reconnect_grace
+            delay = 0.05
+            while time.monotonic() < deadline \
+                    and not self._shutting_down:
+                conn = None
+                try:
+                    conn = self._redial()
+                    ok = self._re_handshake(conn)
+                except Exception:
+                    ok = None
+                if ok is False:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    return False
+                if ok:
+                    replay_ok = False
+                    with self.send_lock:
+                        self.conn = conn
+                        outbox, self._head_outbox = self._head_outbox, []
+                        # Requests PARKED while down already sit in the
+                        # outbox (in order); replay only the ones that
+                        # made it onto the dead conn before the failure,
+                        # so nothing is sent twice.
+                        parked = {id(m) for m in outbox}
+                        with self.pending_lock:
+                            replay = [ent[1] for ent in
+                                      self.pending.values()
+                                      if ent[1] is not None
+                                      and id(ent[1]) not in parked]
+                        try:
+                            # Pending requests were on the wire before
+                            # the parked messages existed: replay them
+                            # first, then the outbox, in one batch.
+                            protocol.send_batch(conn, replay + outbox)
+                            self._conn_down = False
+                            self.head_reconnects += 1
+                            replay_ok = True
+                        except Exception:
+                            self._head_outbox = outbox
+                    if replay_ok:
+                        self._after_reconnect()
+                        return True
+                    # Replay failed (head died again mid-replay): back
+                    # off OUTSIDE send_lock so task threads keep parking
+                    # into the outbox instead of blocking on the lock.
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    time.sleep(delay)
+                    delay = min(1.0, delay * 1.7)
+                    continue
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                time.sleep(delay)
+                delay = min(1.0, delay * 1.7)
+            return False
+
+    def _after_reconnect(self):
+        """Post-replay reconciliation: re-offer retained head-routed
+        specs whose returns we never materialized — the head runs the
+        ones it doesn't already know (at-least-once)."""
+        with self._spec_lock:
+            specs = list(self._inflight_head_specs.values())
+        if specs:
+            self._send(("resubmit_batch", specs))
+
+    _HEAD_SPEC_CAP = 512
+
+    def _note_head_spec(self, spec: dict):
+        """Retain a head-routed PLAIN spec for failover replay (dropped
+        once a return materializes, or FIFO-evicted past the cap)."""
+        if not self._failover or "actor_id" in spec:
+            return
+        with self._spec_lock:
+            self._inflight_head_specs[spec["task_id"][:12]] = spec
+            while len(self._inflight_head_specs) > self._HEAD_SPEC_CAP:
+                self._inflight_head_specs.popitem(last=False)
+
+    def _prune_head_specs(self, oid_bins):
+        if not self._inflight_head_specs:
+            return
+        with self._spec_lock:
+            for b in oid_bins:
+                self._inflight_head_specs.pop(b[:12], None)
 
     # -- descriptor handling ----------------------------------------------
     def materialize(self, descr) -> Any:
@@ -750,6 +951,9 @@ class _WorkerRuntime:
                     lambda rid: ("mget", rid,
                                  [oid.binary() for _, oid in missing],
                                  left))
+                self._prune_head_specs(
+                    [oid.binary() for ((_i, oid), (ok, _d))
+                     in zip(missing, reply) if ok])
                 for (i, _oid), (ok, descr) in zip(missing, reply):
                     if not ok:
                         raise self.materialize_error(descr)
@@ -871,6 +1075,7 @@ class _WorkerRuntime:
             return [ObjectRef(tid.object_id(i), _register=False)
                     for i in range(spec["num_returns"])]
         self._export_for_head_path(spec)
+        self._note_head_spec(spec)
         self._send(("submit", 0, spec))
         # _register=False: the driver counts this worker's reference when it
         # receives the spec (see Runtime.submit_task_from_worker).
@@ -914,6 +1119,7 @@ class _WorkerRuntime:
         if head_specs:
             for spec in head_specs:
                 self._export_for_head_path(spec)
+                self._note_head_spec(spec)
             self._send(("submit_batch", head_specs))
         return out
 
@@ -1152,6 +1358,11 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
     span_start = _time.time()
     with rt._exec_lock:
         rt._executing += 1
+        # Tracked for the failover re-register payload: a head restart
+        # mid-execution must learn this task is still producing results
+        # here (direct-pushed tasks are owned by their caller, not the
+        # head, and are excluded at snapshot time).
+        rt._executing_tasks.append((task, dreply is not None))
     try:
         args, kwargs = _load_args(rt, task)
         if "actor_id" in task:
@@ -1197,6 +1408,9 @@ def _execute(rt: _WorkerRuntime, fns: _FunctionCache, task: dict,
     finally:
         with rt._exec_lock:
             rt._executing -= 1
+            rt._executing_tasks = [
+                (t, d) for t, d in rt._executing_tasks
+                if t is not task]
         rt.current_task_id = None
         rt.current_actor_id = None
         rt.record_span(task["task_id"], name, span_start, _time.time(),
@@ -1490,16 +1704,40 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
     def reader():
         while True:
             try:
-                msg = protocol.recv(conn)
+                msg = protocol.recv(rt.conn)
             except (EOFError, OSError, TypeError):
-                os._exit(0)
-            handle(msg)
+                # Head gone.  With failover on, PARK: keep executing,
+                # buffer outgoing head traffic, re-dial + re-register
+                # for the grace window — a head restart is then a blip,
+                # not this worker's death.  Reference: workers
+                # reconnecting across GCS restart.
+                if not rt._reconnect_head():
+                    os._exit(0)
+            else:
+                handle(msg)
 
     def _queue_empty():
         with tq_cv:
             return not tasks
 
     rt.queue_empty = _queue_empty
+
+    def snapshot_tasks():
+        """Queued + running HEAD-dispatched tasks for the re-register
+        payload: (task_id, num_returns, is_actor_call) rows.  Direct-
+        pushed tasks are excluded — their owner (the pushing caller) is
+        their metadata authority, not the head."""
+        with tq_cv:
+            queued = [m[1] for m in tasks
+                      if m[0] == "exec" and "_dreply" not in m[1]]
+        with rt._exec_lock:
+            running = [t for t, is_direct in rt._executing_tasks
+                       if not is_direct]
+        return [(t["task_id"], t["num_returns"], "actor_id" in t)
+                for t in queued + running]
+
+    rt.snapshot_tasks = snapshot_tasks
+    rt.snapshot_actors = lambda: list(actors.keys())
 
     threading.Thread(target=reader, daemon=True, name="ray_tpu-reader").start()
 

@@ -56,12 +56,18 @@ class ChaosController:
     dedicated thread — the firing site may hold framework locks, and a
     kill that re-enters the runtime from under them would deadlock."""
 
-    def __init__(self, rt=None, arm_syncpoints: bool = True):
+    def __init__(self, rt=None, arm_syncpoints: bool = True, head=None):
         if rt is None:
             from ray_tpu._private.api_internal import require_runtime
 
             rt = require_runtime()
         self._rt = rt
+        # Head manager for kill_head/restart_head: anything exposing
+        # those two methods — canonically cluster_utils.Cluster with
+        # external_head=True.  None = in-process head (killing it would
+        # kill ourselves; the methods then raise).
+        self._head = head
+        self._head_kills = 0
         self._lock = threading.Lock()
         self._timers: List[threading.Timer] = []
         # name -> list of [countdown, action, args] triples
@@ -242,10 +248,50 @@ class ChaosController:
             pass
         return victim.worker_id.hex()
 
+    def attach_head(self, head) -> None:
+        """Late-bind the head manager (the pytest fixture constructs the
+        controller before a test decides to boot an external head)."""
+        self._head = head
+
+    def kill_head(self) -> Optional[int]:
+        """SIGKILL the HEAD process — the last single point of failure.
+        Requires an external head (``Cluster(external_head=True)``
+        passed as ``head=``/``attach_head``); an in-process head shares
+        our pid, so there is nothing survivable to kill.  Counted
+        locally (``stats()["head_kills"]``) because the head's own
+        counter dies with it."""
+        if self._head is None:
+            raise RuntimeError(
+                "kill_head needs an external head: pass head="
+                "Cluster(external_head=True) (or attach_head it)")
+        pid = self._head.kill_head()
+        with self._lock:
+            self._head_kills += 1
+        return pid
+
+    def restart_head(self) -> Optional[int]:
+        """Re-run the killed head with gcs_restore on the same
+        port/authkey; surviving agents/workers/clients reconnect-and-
+        replay on their own."""
+        if self._head is None:
+            raise RuntimeError(
+                "restart_head needs an external head: pass head="
+                "Cluster(external_head=True) (or attach_head it)")
+        return self._head.restart_head()
+
     # ------------------------------------------------------------ admin --
     def stats(self) -> Dict[str, int]:
-        with self._rt.lock:
-            return {"chaos_kills": self._rt.chaos_kills}
+        out = {"chaos_kills": 0}
+        try:
+            with self._rt.lock:
+                out["chaos_kills"] = self._rt.chaos_kills
+        except AttributeError:
+            # Client-runtime controller (external head): the cluster
+            # counter lives server-side — transfer_stats() has it.
+            pass
+        with self._lock:
+            out["head_kills"] = self._head_kills
+        return out
 
     def stop(self):
         with self._lock:

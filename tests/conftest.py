@@ -53,7 +53,12 @@ def chaos_controller():
     fixture AFTER the fixture that boots the runtime, e.g.
     ``ray_start_regular``).  Arms the process's syncpoints for the
     test's duration and disarms + cancels schedules on teardown, so the
-    whole battery can run under ``RAY_TPU_LOCKCHECK=1``."""
+    whole battery can run under ``RAY_TPU_LOCKCHECK=1``.
+
+    ``kill_head``/``restart_head`` are exposed too: attach an external
+    head first (``ctl.attach_head(Cluster(external_head=True))``) —
+    an in-process head shares the test's pid, so there is nothing
+    survivable to kill and the methods raise."""
     from ray_tpu.chaos import ChaosController
 
     ctl = ChaosController()

@@ -266,6 +266,13 @@ def test_chaos_kill_agent_mid_lease_revocation_interplay():
 
 # --------------------------------------------------- lockcheck battery --
 
+@pytest.mark.slow  # duplicate-coverage subprocess drill: the kill/
+#                   restart/reconstruction machinery runs tier-1 in the
+#                   acceptance tests above (and the failover battery),
+#                   and the lock-order pins it checks have sub-second
+#                   tier-1 representatives in tests/test_lockcheck.py;
+#                   this re-run with the checker installed rides the
+#                   slow lane next to the failover lockcheck battery
 def test_chaos_battery_under_lockcheck_zero_cycles():
     """The chaos battery's single-host shape re-run with the lockdep
     checker installed: worker kill + actor restart + reconstruction

@@ -117,7 +117,12 @@ def _start_head(snap, port, key, restore):
 def test_head_kill_restart_client_reconnect(tmp_path):
     """kill -9 the head; a restarted head (same port/authkey) restores
     the snapshot; a client re-attaches, finds the named actor, and runs
-    tasks (VERDICT round-3 'done' criterion)."""
+    tasks (VERDICT round-3 'done' criterion).
+
+    Since the head-failover PR the actor's WORKER survives the head's
+    death (it parks on head-conn EOF and re-registers with the restarted
+    head under the adopted session), so the actor keeps its STATE across
+    the blip — adoption, not a fresh incarnation."""
     snap = str(tmp_path / "gcs.bin")
     key = os.urandom(16).hex()
     with socket.socket() as s:
@@ -125,6 +130,8 @@ def test_head_kill_restart_client_reconnect(tmp_path):
         port = s.getsockname()[1]
 
     head = _start_head(snap, port, key, False)
+    from ray_tpu._private import api_internal
+
     try:
         client = ray.init(address=f"tcp://127.0.0.1:{port}", _authkey=key)
         actor = ray.get_actor("kv_actor")
@@ -135,27 +142,31 @@ def test_head_kill_restart_client_reconnect(tmp_path):
             time.sleep(0.2)
         assert os.path.exists(snap)
         client.disconnect()
+        api_internal.set_global_runtime(None)
 
         head.send_signal(signal.SIGKILL)
         head.wait(timeout=30)
 
         head = _start_head(snap, port, key, True)
-        from ray_tpu._private import api_internal
-
-        api_internal.set_global_runtime(None)
         client = ray.init(address=f"tcp://127.0.0.1:{port}", _authkey=key)
         actor = ray.get_actor("kv_actor")
-        # Fresh incarnation (state lost, identity restored).
-        assert ray.get(actor.put.remote("b", 2), timeout=60) == 1
+        # The surviving worker re-registered its incarnation: state
+        # SURVIVES the head restart ({"a": 1} still there -> len 2).
+        assert ray.get(actor.put.remote("b", 2), timeout=60) == 2
 
         @ray.remote
         def sq(x):
             return x * x
 
         assert ray.get(sq.remote(7), timeout=60) == 49
-        client.disconnect()
-        api_internal.set_global_runtime(None)
     finally:
+        rt = api_internal.get_runtime()
+        if rt is not None and getattr(rt, "is_client", False):
+            try:
+                rt.disconnect()
+            except Exception:
+                pass
+        api_internal.set_global_runtime(None)
         try:
             head.kill()
         except Exception:
