@@ -68,7 +68,7 @@ class ClientRuntime(_WorkerRuntime):
         # each (PR 2's conflation envelope, applied to the put path).
         self._put_buf: list = []
         self._put_buf_bytes = 0
-        self._put_lock = threading.Lock()
+        self._put_lock = threading.Lock()  # lock-order: leaf
 
     def put_object(self, value) -> ObjectRef:
         oid = ObjectID.for_put()

@@ -37,18 +37,22 @@ class Config:
     # reference cutoff is 100KB (``max_direct_call_object_size``,
     # ray_config_def.h:212); we default higher because host pipes on a TPU VM
     # comfortably move 1MB messages and shm setup has fixed cost.
+    # protocheck: env-alias RAY_TPU_MAX_INLINE -- legacy spelling read directly by worker_entry
     max_inline_object_size: int = 1024 * 1024
 
     # Shared-memory store capacity (bytes).  0 = unlimited (bounded by
     # /dev/shm).  Mirrors plasma's store size (object_manager/plasma/).
+    # protocheck: head-only -- workers get their per-node slice as RAY_TPU_STORE_BYTES (head spawn env / agent-computed cap), not this knob
     object_store_memory: int = 0
 
     # Directory for shared-memory segments.
+    # protocheck: head-only -- workers inherit the session store path via RAY_TPU_SHM_DIR_OVERRIDE from their node's store owner
     shm_dir: str = "/dev/shm"
 
     # Bytes of freed-but-still-mapped shm segments kept pooled for in-place
     # reuse (plasma-arena analog: fresh tmpfs pages fault+zero at ~1 GB/s,
     # pooled pages take writes at memcpy speed).  0 disables pooling.
+    # protocheck: env-alias RAY_TPU_POOL_BYTES -- legacy spelling read directly by worker_entry/node_agent
     shm_pool_bytes: int = 1 << 30
 
     # --- Cross-node object transfer (the data-plane fast path;
@@ -67,6 +71,7 @@ class Config:
     # a NAT-internal address on some distros; node agents have the same
     # escape hatch via RAY_TPU_AGENT_ADVERTISE_HOST).  "" = derive from
     # listen_host.
+    # protocheck: head-only -- names the HEAD's advertised object-server host; agents have RAY_TPU_AGENT_ADVERTISE_HOST
     object_advertise_host: str = ""
 
     # --- Direct puts (the WRITE-direction twin of the pooled/striped
@@ -96,10 +101,12 @@ class Config:
     # object store and prefers the top-locality node that fits; it never
     # stalls a class (a preferred-but-full node just falls back to the
     # head-first order, counted in ``locality_misses``).
+    # protocheck: head-only -- placement scoring runs in the head scheduler only
     locality_scheduling: bool = True
     # Minimum bytes of node-homed argument data before locality overrides
     # the head-first placement order (below it, transfer is cheaper than
     # disturbing the packing).
+    # protocheck: head-only -- placement scoring runs in the head scheduler only
     locality_min_bytes: int = 1024 * 1024
 
     # --- Pipelined argument prefetch (reference: raylets pull task
@@ -179,33 +186,41 @@ class Config:
 
     # Seconds a worker may sit idle before the pool reaps it (reference:
     # idle worker killing in worker_pool.cc).
+    # protocheck: head-only -- the idle-worker reaper runs in the head's pool
     idle_worker_timeout_s: float = 300.0
 
     # Soft cap on extra workers spawned when existing workers block in
     # ``ray.get`` (reference: worker cap w/ backoff, ray_config_def.h:174-187).
+    # protocheck: head-only -- blocked-worker cap enforced by the head's spawn path
     max_extra_blocked_workers: int = 16
 
     # Task retry default (reference: max_retries=3 for normal tasks).
+    # protocheck: head-only -- retry budgets are seeded at head registration (direct-path specs carry explicit max_retries)
     default_max_retries: int = 3
 
     # Tasks pipelined onto one leased worker before a new worker is leased
     # (reference: max_tasks_in_flight_per_worker in
     # direct_task_transport.h:75 — kills the per-task result round trip).
+    # protocheck: head-only -- the pipeline bound is applied at grant time; holders receive it as the grant's slots field
     max_tasks_in_flight_per_worker: int = 10
 
     # Health-check cadence for worker processes (reference: GCS pull-based
     # health checks, gcs_health_check_manager.h:39).
+    # protocheck: head-only -- worker health checks run in the head
     health_check_period_s: float = 5.0
 
     # Wait this long for a worker process to start before declaring failure.
+    # protocheck: head-only -- spawn timeout enforced by the head
     worker_start_timeout_s: float = 60.0
 
     # Number of workers prestarted at init when num_cpus not yet demanded
     # (reference: prestart in worker_pool.cc).
+    # protocheck: head-only -- prestart happens at head init
     prestart_workers: int = 0
 
     # Multiprocessing start method: "forkserver" is fastest that is still
     # safe with JAX in the driver ("fork" is not — XLA runtime threads).
+    # protocheck: head-only -- consumed by the head's process spawner
     worker_start_method: str = "forkserver"
 
     # --- Fault tolerance (reference: object_recovery_manager.h:41 +
@@ -237,10 +252,12 @@ class Config:
     # Where over-capacity shm objects spill (reference:
     # local_object_manager.h:41 spill to external storage).  Empty =
     # /tmp/ray_tpu_spill_<session>.
+    # protocheck: head-only -- workers/agents get the session-resolved path via RAY_TPU_SPILL_DIR_OVERRIDE
     spill_dir: str = ""
 
     # Host the head's TCP listener binds (node agents + their workers dial
     # in here).  Use "0.0.0.0" for real multi-host clusters.
+    # protocheck: head-only -- the head's own listener bind address
     listen_host: str = "127.0.0.1"
 
     # --- GCS-analog fault tolerance (reference: GCS table persistence via
@@ -248,17 +265,22 @@ class Config:
     # GcsInitData load-on-restart path, gcs_server.h:77). ---
     # Snapshot file for head metadata (KV, functions, named actors, jobs).
     # "" disables snapshotting.
+    # protocheck: head-only -- head snapshot machinery
     gcs_snapshot_path: str = ""
     # Snapshot cadence; dirty state is written at most this often.
+    # protocheck: head-only -- head snapshot machinery
     gcs_snapshot_interval_s: float = 2.0
     # Load the snapshot at init (head restart): restores KV/functions and
     # re-creates named actors per their creation specs.
+    # protocheck: head-only -- head restart restore switch
     gcs_restore: bool = False
     # Fixed TCP listener port (0 = ephemeral).  A restarting head must
     # rebind the old port so agents and clients can re-dial it.
+    # protocheck: head-only -- the head's own listener port
     listen_port: int = 0
     # Fixed cluster authkey (hex; "" = random per session).  Needed across
     # head restarts so agents/clients can re-authenticate.
+    # protocheck: head-only -- session authkey reaches workers as RAY_TPU_AUTHKEY in the spawn env
     authkey_hex: str = ""
 
     # --- Head failover (reference: workers reconnecting across a GCS
@@ -290,6 +312,7 @@ class Config:
     # with head_failover: with failover on a reconnecting agent keeps
     # its workers; with it off it kills them first, the legacy
     # behavior).
+    # protocheck: head-only -- agent-process knob, read from the agent's own environment (launcher/operator-set)
     agent_reconnect: bool = True
 
     # --- OOM memory monitor (reference: src/ray/common/memory_monitor.h
@@ -298,16 +321,20 @@ class Config:
     # node). ---
     # Node memory usage fraction above which the monitor kills one task
     # worker per interval.  0 disables.
+    # protocheck: head-only -- monitor knobs reach node agents in the agent_ack config dict
     memory_monitor_threshold: float = 0.95
+    # protocheck: head-only -- monitor knobs reach node agents in the agent_ack config dict
     memory_monitor_interval_s: float = 1.0
     # Test hook: read the usage fraction from this file instead of
     # /proc/meminfo (reference tests inject usage the same way).
+    # protocheck: head-only -- monitor knobs reach node agents in the agent_ack config dict
     memory_monitor_test_file: str = ""
 
     # Stream worker stdout/stderr to the driver with a worker prefix
     # (reference: log_monitor.py + log_to_driver in ray.init).  Worker
     # output always lands in per-worker files under the session dir;
     # this flag controls the re-print at the driver.
+    # protocheck: head-only -- the re-print of worker logs happens in the head's monitor thread
     log_to_driver: bool = True
 
     @classmethod

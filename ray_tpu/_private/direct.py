@@ -35,6 +35,13 @@ through the head once (``store_addr`` — address + verb caps) and pulls
 the segment over pooled, striped connections straight into local shm
 (object_transfer.py).  The head-relayed ``getparts`` path stays as the
 fallback for consumers without direct reachability.
+
+Wire contract: every verb this module sends or handles (``dexec``/
+``dexec_batch``/``dfunc``/``dfree``/``dmsg``/``dresult``/
+``dresult_batch``/``dspill`` on the direct plane, plus the lease and
+ownership-delegation verbs to the head) is declared in
+``protocol.VERBS`` and machine-checked against these sites by
+``python -m ray_tpu.devtools.protocheck`` (roles, arity, caps gating).
 """
 
 from __future__ import annotations

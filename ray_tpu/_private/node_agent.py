@@ -18,6 +18,13 @@ TPU-era equivalent:
 
 Run: ``python -m ray_tpu._private.node_agent`` with RAY_TPU_HEAD_ADDRESS /
 RAY_TPU_AUTHKEY / RAY_TPU_AGENT_* env vars (see cluster_utils.Cluster).
+
+Wire contract: the agent-plane verbs (``agent_ready``/``agent_ack``,
+``spawn_worker``/``kill_worker``/``kill_worker_hard``,
+``read_segment``/``segment``, ``unlink_segment``, ``oom_pressure``,
+``worker_logs``, ``shutdown``) are declared in ``protocol.VERBS`` and
+machine-checked against this module's send/handle sites by
+``python -m ray_tpu.devtools.protocheck``.
 """
 
 from __future__ import annotations

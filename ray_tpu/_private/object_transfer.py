@@ -273,7 +273,7 @@ class PutRegistry:
         # Pending reservations still legitimately pin the store through
         # their own PutReservation.store until resolved.
         self._store_ref = weakref.ref(store)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: leaf
         self._pending: dict = {}  # name -> shm_store.PutReservation
 
     def reserve(self, oid_bin: bytes, total: int) -> str:
@@ -485,7 +485,7 @@ class _PoolHost:
         self._authkey = authkey
         self._pool_size = pool_size
         self._pools: Dict[str, _ConnPool] = {}  # store_id -> pool
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: leaf
 
     def _pool_for(self, store_id: str, addr: str) -> _ConnPool:
         stale = None
@@ -985,7 +985,7 @@ class PullRegistry:
     RETAIN_TTL_S = 10.0
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: leaf
         self._inflight: Dict[tuple, _PullEntry] = {}
         self._retained: "deque[tuple]" = deque()  # FIFO of DONE keys
         self._retained_bytes = 0

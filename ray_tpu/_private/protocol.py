@@ -40,7 +40,6 @@ worker → driver
   ("addref", object_id_bytes) / ("decref", object_id_bytes)
   ("decref_batch", [object_id_bytes])   buffered ref drops
   ("blocked", task_id_bytes) / ("unblocked", task_id_bytes)
-  ("actor_exit", actor_id_bytes, ok, error_descr)
 lease plane (decentralized dispatch; all verbs are capability-gated:
 holders opt in via the ``lease_req`` opts dict / the ``_spill_ok`` task
 flag, so a peer that never advertises them is never sent one)
@@ -88,12 +87,280 @@ Object descriptors (Descr) carry values between processes:
 Transport: same message set over an AF_UNIX socket (workers on the head
 host) or TCP (node agents and the workers they spawn on other hosts) —
 the reference speaks gRPC for both (``node_manager.proto``).
+
+The grammar above is narrative; the AUTHORITATIVE contract is the
+``VERBS`` catalog below (verb → sender/handler roles, arity, capability
+gate, doc — our one-file analog of the reference's 22 proto schemas).
+``python -m ray_tpu.devtools.protocheck`` statically cross-checks every
+send and handle site in the tree against it, and ``protocheck --doc``
+renders it as the README's wire-protocol table.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+from typing import NamedTuple, Optional, Tuple
+
+
+class Verb(NamedTuple):
+    """One wire verb's contract — the machine-checked half of the
+    docstring above (``ray_tpu.devtools.protocheck`` cross-checks every
+    send and handle site against this catalog; ``protocheck --doc``
+    renders it as the README's wire-protocol table).
+
+    ``senders``/``handlers`` name module roles (head = runtime/head_main,
+    worker = worker_main/direct, client, agent = node_agent, objsrv =
+    object_transfer/shm_store).  ``arity`` is the legal tuple length
+    INCLUDING the verb tag, as an inclusive (min, max) — ``None`` means
+    deliberately variable (the per-exchange ``ok`` replies).  ``caps``
+    names the capability family that must gate every send (the PR 3/6/7
+    "never probe an old peer" convention).  ``external`` marks verbs
+    whose peers live outside the analyzed tree (legacy spellings,
+    dynamically-built envelopes) so the whole-program liveness check
+    skips them."""
+
+    senders: Tuple[str, ...]
+    handlers: Tuple[str, ...]
+    arity: Optional[Tuple[int, int]]
+    doc: str
+    caps: Optional[str] = None
+    external: bool = False
+
+
+VERBS = {
+    # -- driver/head <-> worker control plane ------------------------------
+    "exec": Verb(("head", "worker"), ("worker",), (2, 2),
+                 "run a task / actor method (workers also self-enqueue "
+                 "direct-pushed tasks under this tag)"),
+    "create_actor": Verb(("head",), ("worker",), (2, 2),
+                         "instantiate an actor class on this worker"),
+    "func": Verb(("head",), ("worker",), (3, 3),
+                 "function/class definition (cloudpickle)"),
+    "obj": Verb(("head",), ("worker", "client"), (4, 4),
+                "reply to a worker getparts"),
+    "mgot": Verb(("head",), ("worker", "client"), (3, 3),
+                 "reply to a batched mget"),
+    "waited": Verb(("head",), ("worker", "client"), (3, 3),
+                   "reply to a wait"),
+    "reply": Verb(("head",), ("worker", "client"), (3, 3),
+                  "generic request reply (store_addr, state_req, jobs, "
+                  "actor requests, v1 lease_req)"),
+    "free_segment": Verb(("head",), ("worker",), (4, 4),
+                         "owner freed a segment this worker created; "
+                         "pool pages iff reusable"),
+    "kill": Verb(("head",), ("worker",), (1, 1), "graceful shutdown"),
+    "steal": Verb(("head",), ("worker",), (3, 3),
+                  "reclaim queued-but-unstarted task ids from a worker"),
+    "ready": Verb(("worker",), ("head",), (4, 4),
+                  "worker hello: id, pid, direct-server address"),
+    "result": Verb(("worker",), ("head",), (5, 5),
+                   "task finished: id, ok, returns, meta"),
+    "result_batch": Verb(("worker",), ("head",), (2, 2),
+                         "coalesced results (one pickle+write)"),
+    "spans": Verb(("worker",), ("head",), (2, 2),
+                  "task execution spans (ray timeline)"),
+    "event": Verb(("worker",), ("head",), (3, 3),
+                  "generic worker->driver pubsub (train streaming)"),
+    "xfer_stats": Verb(("worker",), ("head",), (2, 2),
+                       "periodic data-plane/lease counter deltas"),
+    "getparts": Verb(("worker",), ("head",), (3, 3),
+                     "fetch a remote segment's serialized parts"),
+    "wait": Verb(("worker",), ("head",), (5, 5),
+                 "blocking wait on object ids"),
+    "mget": Verb(("worker", "client"), ("head",), (4, 4),
+                 "batched get"),
+    "submit": Verb(("worker", "client"), ("head",), (3, 3),
+                   "nested task submission (fire-and-forget)"),
+    "submit_batch": Verb(("worker", "client"), ("head",), (2, 2),
+                         "bulk nested submission (one registration "
+                         "pass)"),
+    "resubmit_batch": Verb(("worker", "client"), ("head",), (2, 2),
+                           "failover replay of retained head-routed "
+                           "specs (head filters for at-least-once)"),
+    "put": Verb(("client",), ("head",), (4, 4),
+                "small inline client put (rides the put conflation "
+                "buffer)"),
+    "put_parts": Verb(("client",), ("head",), (5, 5),
+                      "legacy client put: whole value in one control "
+                      "message, head assembles"),
+    "put_commit": Verb(("client",), ("head",), (4, 4),
+                       "direct put: payload already streamed into the "
+                       "destination store; O(1) descriptor "
+                       "registration"),
+    "addref": Verb(("worker", "client"), ("head",), (2, 2),
+                   "object refcount +1"),
+    "decref": Verb(("worker", "client"), ("head",), (2, 2),
+                   "object refcount -1 (aggregate head ref of a "
+                   "delegated object)"),
+    "decref_batch": Verb(("worker", "client"), ("head",), (2, 2),
+                         "buffered ref drops"),
+    "addref_batch": Verb(("worker", "client"), ("head",), (2, 2),
+                         "buffered ref bumps (nested ids in results)"),
+    "actor_addref": Verb(("worker", "client"), ("head",), (2, 2),
+                         "actor-handle refcount +1 (pickle-time)"),
+    "actor_decref_batch": Verb(("worker", "client"), ("head",), (2, 2),
+                               "buffered actor-handle ref drops"),
+    "actor_token_new": Verb(("worker", "client"), ("head",), (3, 3),
+                            "actor handle serialized (borrow token)"),
+    "actor_token_used": Verb(("worker", "client"), ("head",), (3, 3),
+                             "borrowed actor handle deserialized"),
+    "actor_addr_req": Verb(("worker", "client"), ("head",), (3, 3),
+                           "resolve an actor's direct-channel address"),
+    "blocked": Verb(("worker",), ("head",), (2, 2),
+                    "worker blocked in get/wait (lend the slot)"),
+    "unblocked": Verb(("worker",), ("head",), (2, 2),
+                      "worker resumed from get/wait"),
+    "stolen": Verb(("worker",), ("head",), (3, 3),
+                   "reply to a steal: task ids actually reclaimed"),
+    "store_addr": Verb(("worker",), ("head",), (3, 3),
+                       "resolve a store's object-server address "
+                       "(+ caps)"),
+    "state_req": Verb(("worker", "client"), ("head",), (4, 4),
+                      "state introspection query (ray status/list)"),
+    "kill_actor_req": Verb(("worker", "client"), ("head",), (4, 4),
+                           "ray.kill(actor)"),
+    "get_actor_req": Verb(("worker", "client"), ("head",), (4, 4),
+                          "ray.get_actor(name)"),
+    "create_actor_req": Verb(("worker", "client"), ("head",), (4, 4),
+                             "synchronous actor creation request"),
+    "cluster_info": Verb(("worker", "client"), ("head",), (2, 2),
+                         "nodes/resources snapshot"),
+    "get_package": Verb(("worker",), ("head",), (3, 3),
+                        "fetch a working_dir package by id"),
+    "job_submit": Verb(("client",), ("head",), (5, 5),
+                       "job API: submit entrypoint"),
+    "job_status": Verb(("client",), ("head",), (3, 3),
+                       "job API: status"),
+    "job_logs": Verb(("client",), ("head",), (3, 3), "job API: logs"),
+    "job_stop": Verb(("client",), ("head",), (3, 3), "job API: stop"),
+    "job_list": Verb(("client",), ("head",), (2, 2), "job API: list"),
+    "actor_checkpoint": Verb(("worker",), ("head",), (3, 3),
+                             "latest __ray_save__ descriptor from a "
+                             "restartable actor"),
+    # -- lease plane (decentralized dispatch) ------------------------------
+    "lease_req": Verb(("worker", "client"), ("head",), (4, 5),
+                      "worker/client asks for leases; optional opts "
+                      "dict {v:1, hint} selects the v1 dict reply"),
+    "lease_grant": Verb(("head",), ("worker", "client"), (6, 6),
+                        "unsolicited bulk grant piggybacked on a "
+                        "head-brokered submit burst", caps="lease_v1"),
+    "lease_renew": Verb(("worker",), ("head",), (2, 2),
+                        "holder liveness, one message per N leased "
+                        "pushes"),
+    "lease_return": Verb(("worker",), ("head",), (2, 2),
+                         "holder done with a leased worker"),
+    "lease_revoke": Verb(("head",), ("worker", "client"), (2, 2),
+                         "leased worker gone (node death / TTL "
+                         "expiry)"),
+    "dspill": Verb(("worker",), ("worker",), (3, 3),
+                   "executor -> holder: pushed task bounced (queue over "
+                   "lease_spillback_depth)"),
+    # -- direct plane (worker <-> worker actor/lease channels) -------------
+    "dexec": Verb(("worker",), ("worker",), (3, 3),
+                  "push one task over a lease/actor channel"),
+    "dexec_batch": Verb(("worker",), ("worker",), (2, 2),
+                        "coalesced dexec frames (per-lease conflation "
+                        "sender)"),
+    "dfunc": Verb(("worker",), ("worker",), (3, 3),
+                  "function definition rides the direct channel"),
+    "dfree": Verb(("worker",), ("worker",), (4, 4),
+                  "owner freed a segment the executor created"),
+    "dmsg": Verb(("worker",), ("worker",), (3, 3),
+                 "out-of-band payload on an actor channel "
+                 "(collectives)"),
+    "dresult": Verb(("worker",), ("worker",), (5, 5),
+                    "direct task result (rid, ok, returns, meta)"),
+    "dresult_batch": Verb(("worker",), ("worker",), (2, 2),
+                          "coalesced direct results"),
+    # -- worker-ownership plane (direct path, via head) --------------------
+    "export_obj": Verb(("worker",), ("head",), (2, 2),
+                       "delegate worker-owned objects to the head "
+                       "directory"),
+    "export_complete": Verb(("worker",), ("head",), (2, 2),
+                            "delegated export descriptors are final"),
+    "descr_update": Verb(("worker",), ("head",), (2, 2),
+                         "owner-side descriptor moves (spill/restore)"),
+    "free_remote": Verb(("worker",), ("head",), (4, 4),
+                        "unlink a segment homed in another node's "
+                        "store"),
+    # -- node-agent plane --------------------------------------------------
+    "agent_ready": Verb(("agent",), ("head",), (2, 2),
+                        "agent hello: node info + advertised "
+                        "object_caps"),
+    "agent_ack": Verb(("head",), ("agent",), (4, 4),
+                      "agent handshake reply: node id, session, "
+                      "config"),
+    "spawn_worker": Verb(("head",), ("agent",), (3, 3),
+                         "fork a worker on this node with env "
+                         "overrides"),
+    "kill_worker": Verb(("head",), ("agent",), (2, 2),
+                        "terminate a worker process"),
+    "kill_worker_hard": Verb(("head",), ("agent",), (2, 2),
+                             "SIGKILL a worker (chaos/OOM paths)"),
+    "read_segment": Verb(("head",), ("agent",), (3, 3),
+                         "relay-read a segment from the agent's store"),
+    "unlink_segment": Verb(("head",), ("agent",), (3, 3),
+                           "free a segment in the agent's store"),
+    "shutdown": Verb(("head",), ("agent",), (1, 1),
+                     "tear the node down"),
+    "segment": Verb(("agent",), ("head",), (4, 4),
+                    "reply to read_segment"),
+    "oom_pressure": Verb(("agent",), ("head",), (2, 2),
+                         "node memory fraction crossed the monitor "
+                         "threshold"),
+    "worker_logs": Verb(("agent",), ("head",), (2, 2),
+                        "batched worker stdout/stderr lines"),
+    # -- handshakes / failover ---------------------------------------------
+    "client_ready": Verb(("client",), ("head",), (2, 2),
+                         "client hello (nonce)"),
+    "client_ack": Verb(("head",), ("client",), (2, 3),
+                       "client handshake reply; the 3rd element "
+                       "(direct-put bootstrap info dict) is absent from "
+                       "old heads"),
+    "reregister": Verb(("worker", "client"), ("head",), (2, 2),
+                       "failover re-registration (workers, clients, "
+                       "reconnecting agents' workers)"),
+    "reregister_ack": Verb(("head",), ("worker",), (2, 2),
+                           "re-registration accepted"),
+    "reregister_nack": Verb(("head",), ("worker",), (1, 1),
+                            "re-registration refused (unknown "
+                            "session)"),
+    # -- object-server data plane (capability-gated verbs) -----------------
+    "fetch": Verb(("objsrv",), ("objsrv",), (2, 2),
+                  "stream a whole segment"),
+    "fetch_range": Verb(("objsrv",), ("objsrv",), (4, 4),
+                        "stream one byte-range stripe; first stripe "
+                        "doubles as the size probe", caps="object_caps"),
+    "reserve_put": Verb(("objsrv",), ("objsrv",), (3, 3),
+                        "preallocate the destination segment for a "
+                        "direct put", caps="object_caps"),
+    "put_range": Verb(("objsrv",), ("objsrv",), (4, 4),
+                      "one byte-range stripe of a pending put",
+                      caps="object_caps"),
+    "commit_put": Verb(("objsrv",), ("objsrv",), (2, 2),
+                       "seal a pending put", caps="object_caps"),
+    "abort_put": Verb(("objsrv",), ("objsrv",), (2, 2),
+                      "tear down a pending put", caps="object_caps"),
+    "close": Verb(("objsrv",), ("objsrv",), (1, 1),
+                  "end this object-server connection"),
+    "ok": Verb(("objsrv",), ("objsrv",), None,
+               "per-exchange success reply (shape varies by request; "
+               "consumed inline by the requester, not via a dispatch "
+               "chain)", external=True),
+    "err": Verb(("objsrv",), ("objsrv",), (2, 2),
+                "per-exchange failure reply (consumed inline)",
+                external=True),
+    # -- envelopes ---------------------------------------------------------
+    "batch": Verb(("head", "worker", "client", "agent"),
+                  ("head", "worker", "client", "agent"), (2, 2),
+                  "N back-to-back messages as one pickle+write "
+                  "(built dynamically by make_batch)", external=True),
+    "msg_batch": Verb(("head", "worker", "client", "agent"),
+                      ("head", "worker", "client", "agent"), (2, 2),
+                      "legacy batch-envelope spelling from old peers",
+                      external=True),
+}
 
 
 def enable_nodelay(conn) -> None:

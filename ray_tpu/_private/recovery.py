@@ -82,7 +82,7 @@ class LineageTable:
     """
 
     def __init__(self, budget_bytes: int):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: leaf
         self.budget = int(budget_bytes)
         self._entries: Dict[bytes, dict] = {}
         self._order: deque = deque()  # FIFO of task prefixes for eviction

@@ -708,7 +708,7 @@ class Runtime:
         # off the shards are never marked and every site dispatches
         # inline exactly as before.
         self._dispatch_dirty: set = set()
-        self._dispatch_dirty_lock = threading.Lock()
+        self._dispatch_dirty_lock = threading.Lock()  # lock-order: leaf
         self._dispatch_event = threading.Event()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
@@ -2289,6 +2289,13 @@ class Runtime:
             # lineage for direct-path tasks and arm actor checkpoint
             # hooks — both must see the driver's _system_config.
             "RAY_TPU_RECOVERY": "1" if self.config.recovery else "0",
+            # The legacy lineage escape hatch gates every DirectCaller's
+            # worker-side table exactly like the head's — a driver
+            # turning it off via _system_config must reach them (found
+            # by protocheck RTL504: the knob was read in workers but
+            # plumbed to neither spawn path).
+            "RAY_TPU_LINEAGE_ENABLED":
+                "1" if self.config.lineage_enabled else "0",
             "RAY_TPU_LINEAGE_BYTES_BUDGET":
                 str(self.config.lineage_bytes_budget),
             "RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S":
@@ -2974,7 +2981,7 @@ class Runtime:
                     hint = self._spill_hint_locked(ok[0].lease_req or {},
                                                    ok)
             if rid is None:
-                worker_send_safe(lessee, ("lease_grant", klass_items, out,
+                worker_send_safe(lessee, ("lease_grant", klass_items, out,  # noqa: RTL503 -- rid-None pushes are built only by _maybe_offer_lease, which gates on worker.lease_caps; solicited grants ride the "reply" verb
                                           slots, ttl, hint))
             elif v1:
                 worker_send_safe(lessee, ("reply", rid,
@@ -3307,7 +3314,7 @@ class Runtime:
             # clean snapshot must not replace it with a stale image.
             if self._stopped and not clean:
                 return
-            self._snapshot_gcs_inner(clean)
+            self._snapshot_gcs_inner(clean)  # noqa: RTL505 -- _gcs_write_lock is strictly OUTER to the runtime lock (this is its only acquisition site); nothing takes it under self.lock
 
     # Object-row rebuild policy for huge tables: below the threshold
     # every snapshot rebuilds the rows (exact); above it the O(#objects)
@@ -4713,8 +4720,6 @@ class Runtime:
                     old, actor.checkpoint = actor.checkpoint, descr
                     if old is not None:
                         self._free_checkpoint_locked(actor, old)
-        elif tag == "actor_exit":
-            pass
 
     def submit_task_from_worker(self, spec: dict, submitter=None):
         """Nested submission: worker-generated task, driver-owned objects."""

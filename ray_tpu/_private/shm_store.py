@@ -48,7 +48,7 @@ _HEADER = struct.Struct("<8sQ")  # magic, meta_len
 _PARALLEL_COPY_MIN = 16 << 20
 _COPY_THREADS = min(8, max(1, (os.cpu_count() or 1)))
 _copy_pool = None
-_copy_pool_lock = threading.Lock()
+_copy_pool_lock = threading.Lock()  # lock-order: leaf
 
 
 def _parallel_copy(mm: mmap.mmap, off: int, buf) -> None:
@@ -178,7 +178,7 @@ class ShmStore:
         self._dir = shm_dir if os.path.isdir(shm_dir) else "/tmp"
         self._capacity = capacity
         self._session = session_id or os.urandom(4).hex()
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: leaf
         self._used = 0
         # Per-NODE accounting: every process writing this directory under
         # a capacity shares one flock'd counter file, so the cap bounds

@@ -57,7 +57,7 @@ class _WorkerRuntime:
         self.max_inline = max_inline
         self.req_counter = itertools.count(1)
         self.pending: Dict[int, "queue.SimpleQueue"] = {}
-        self.pending_lock = threading.Lock()
+        self.pending_lock = threading.Lock()  # lock-order: leaf
         # Dropped refs accumulate here and ride out as one ("decref_batch")
         # before the next outgoing message (or via the periodic flusher).
         # Append-only from ObjectRef.__del__: __del__ can fire from GC *during*
@@ -116,7 +116,7 @@ class _WorkerRuntime:
         # dedup + dependency prefetch).
         self._pull_registry = object_transfer.PullRegistry()
         self._xfer_sent: Dict[str, int] = {}
-        self._xfer_lock = threading.Lock()
+        self._xfer_lock = threading.Lock()  # lock-order: leaf
         self.arg_prefetch_depth = int(
             os.environ.get("RAY_TPU_ARG_PREFETCH_DEPTH", "2") or 0)
         self.prefetcher = _ArgPrefetcher(self, self.arg_prefetch_depth)
@@ -178,7 +178,7 @@ class _WorkerRuntime:
         from collections import OrderedDict as _OD
 
         self._inflight_head_specs: "_OD[bytes, dict]" = _OD()
-        self._spec_lock = threading.Lock()
+        self._spec_lock = threading.Lock()  # lock-order: leaf
         # Hooks worker_entry fills in for the re-register payload.
         self.snapshot_tasks = lambda: []
         self.snapshot_actors = lambda: []
@@ -360,19 +360,30 @@ class _WorkerRuntime:
         bytes) to the head, which aggregates them next to its
         brokered_parts/relayed_segments stats.  Rides the periodic
         flusher and the queue-drain flush; no-delta calls send nothing.
-        The claim (delta + baseline update) is atomic under _xfer_lock —
-        two concurrent flushers must never report the same delta twice."""
+
+        The stats() snapshots run OUTSIDE _xfer_lock (each takes its own
+        lock — the pull registry's leaf, the DirectCaller's big
+        ownership lock — and holding the claim lock across them was an
+        undeclared nesting edge, found by protocheck RTL505).  The claim
+        itself stays atomic under _xfer_lock, and because every counter
+        is cumulative, per-key MONOTONIC claiming makes racing flushers
+        safe: a flusher that snapshotted earlier but claims later sees
+        nothing new and ships nothing — never a duplicate or negative
+        delta."""
+        cur = self._pull_registry.stats()
+        # Lease-plane counters ride the same delta stream (the head
+        # aggregates leased_submits/spillbacks next to its own
+        # lease_grants/head_brokered_submits).
+        cur.update(self.direct.stats())
         with self._xfer_lock:
-            cur = self._pull_registry.stats()
-            # Lease-plane counters ride the same delta stream (the head
-            # aggregates leased_submits/spillbacks next to its own
-            # lease_grants/head_brokered_submits).
-            cur.update(self.direct.stats())
-            delta = {k: v - self._xfer_sent.get(k, 0)
-                     for k, v in cur.items()}
-            if not any(delta.values()):
+            delta = {}
+            for k, v in cur.items():
+                sent = self._xfer_sent.get(k, 0)
+                if v > sent:
+                    delta[k] = v - sent
+                    self._xfer_sent[k] = v
+            if not delta:
                 return
-            self._xfer_sent = cur
         self._send(("xfer_stats", delta))
 
     def flush_decrefs(self):
@@ -501,7 +512,7 @@ class _WorkerRuntime:
         if not self._failover or self._shutting_down:
             return False
         with self._reconn_lock:
-            with self.send_lock:
+            with self.send_lock:  # noqa: RTL505 -- the reconnect serializer is strictly OUTER to send_lock; no send path takes _reconn_lock
                 self._conn_down = True
             deadline = time.monotonic() + self._reconnect_grace
             delay = 0.05
@@ -521,7 +532,7 @@ class _WorkerRuntime:
                     return False
                 if ok:
                     replay_ok = False
-                    with self.send_lock:
+                    with self.send_lock:  # noqa: RTL505 -- reconnect serializer OUTER to send_lock (see above); the replay must exclude concurrent senders
                         self.conn = conn
                         outbox, self._head_outbox = self._head_outbox, []
                         # Requests PARKED while down already sit in the
@@ -1266,7 +1277,7 @@ class _ArgPrefetcher:
         self._depth = depth
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._threads = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: leaf
         # Keys queued but not yet processed: duplicate offers of one
         # segment (enqueue-time hook + _load_args, or N queued tasks
         # sharing an arg) collapse to one queue entry instead of N

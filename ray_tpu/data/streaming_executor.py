@@ -81,7 +81,7 @@ class StreamingStats:
     readers."""
 
     def __init__(self, budget_bytes: int, inflight_cap: int):
-        self._lock = threading.Lock()  # LEAF — see module docstring
+        self._lock = threading.Lock()  # lock-order: leaf (see module docstring)
         self.budget_bytes = budget_bytes
         self.inflight_cap = inflight_cap
         self.peak_inflight_bytes = 0

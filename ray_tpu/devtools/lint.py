@@ -11,6 +11,12 @@ Usage::
 
     python -m ray_tpu.devtools.lint ray_tpu/ tests/
     python -m ray_tpu.devtools.lint --list-rules
+    python -m ray_tpu.devtools.lint --select=RTL402 ray_tpu/   # one rule
+    python -m ray_tpu.devtools.lint --doc                      # rule table
+
+Whole-program rules (RTL5xx — wire-protocol conformance, capability
+gating, knob plumbing, lock-order inference) live in the sibling
+``ray_tpu.devtools.protocheck``.
 
 Findings print as ``path:line:col: RTLxxx message`` and the process exits
 non-zero when any un-suppressed finding remains.
@@ -531,16 +537,50 @@ def lint_paths(paths) -> List[Finding]:
     return findings
 
 
-def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
+def rules_doc() -> str:
+    """Markdown table of the per-file rule catalog (``--doc``)."""
+    lines = ["| rule | what it catches |", "|---|---|"]
+    for rule_id in sorted(RULES):
+        lines.append(f"| {rule_id} | {RULES[rule_id]} |")
+    return "\n".join(lines)
+
+
+def run_cli(argv, *, rules, usage, runner, doc=None) -> int:
+    """Shared CLI driver for the devtools analyzers (this linter and
+    ``protocheck``): --list-rules, --doc, validated --select, the
+    missing-path guard, and the findings print/exit tail live ONCE here
+    so the two tools cannot drift.
+
+    ``runner(paths, select)`` returns the (already select-filtered)
+    finding list — or an int to take over the exit code (protocheck's
+    ``--dump``)."""
+    argv = list(argv)
     if "--list-rules" in argv:
-        for rule_id in sorted(RULES):
-            print(f"{rule_id}  {RULES[rule_id]}")
+        for rule_id in sorted(rules):
+            print(f"{rule_id}  {rules[rule_id]}")
         return 0
+    if doc is not None and "--doc" in argv:
+        print(doc())
+        return 0
+    select = None
+    for arg in list(argv):
+        if arg.startswith("--select="):
+            select = {s.strip().upper() for s in
+                      arg.split("=", 1)[1].split(",") if s.strip()}
+            argv.remove(arg)
+    if select:
+        # A typo'd selector must not filter every finding and report a
+        # green run (prefix match is the contract: RTL4 = the family).
+        unknown = sorted(s for s in select
+                         if not any(r.startswith(s) for r in rules))
+        if unknown:
+            print(f"error: --select matches no rule: "
+                  f"{', '.join(unknown)} (known: "
+                  f"{', '.join(sorted(rules))})", file=sys.stderr)
+            return 2
     paths = [a for a in argv if not a.startswith("-")]
     if not paths:
-        print("usage: python -m ray_tpu.devtools.lint [--list-rules] "
-              "PATH [PATH ...]", file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 2
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
@@ -549,7 +589,9 @@ def main(argv=None) -> int:
         print(f"error: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         return 2
-    findings = lint_paths(paths)
+    findings = runner(paths, select)
+    if isinstance(findings, int):
+        return findings
     for finding in findings:
         print(repr(finding))
     if findings:
@@ -557,6 +599,23 @@ def main(argv=None) -> int:
               f"with '# noqa: <RULE-ID> -- reason'.", file=sys.stderr)
         return 1
     return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def runner(paths, select):
+        findings = lint_paths(paths)
+        if select:
+            # Prefix match so --select=RTL4 runs the whole lock family.
+            findings = [f for f in findings
+                        if any(f.rule.startswith(s) for s in select)]
+        return findings
+
+    return run_cli(
+        argv, rules=RULES, doc=rules_doc, runner=runner,
+        usage="usage: python -m ray_tpu.devtools.lint [--list-rules] "
+              "[--doc] [--select=RTLxxx,...] PATH [PATH ...]")
 
 
 if __name__ == "__main__":

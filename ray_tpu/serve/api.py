@@ -323,7 +323,7 @@ class ServeController:
         """One control-loop tick: health-check, replace dead, scale to
         target (static or autoscaled), roll one outdated replica."""
         with self._reconcile_lock:
-            return self._reconcile_once()
+            return self._reconcile_once()  # noqa: RTL505 -- the reconcile serializer is strictly OUTER to the controller lock; no path under _lock takes _reconcile_lock
 
     DRAIN_S = 3.0
 

@@ -57,7 +57,7 @@ class _Batcher:
         self._instance = instance
         self._max = max_batch_size
         self._timeout = batch_wait_timeout_s
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: leaf
         self._pending: List[_Entry] = []
         self._full = threading.Event()
         # Pre-collection leader (elected at first append; cleared when it

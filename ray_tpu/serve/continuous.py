@@ -116,7 +116,7 @@ class _ContinuousBatcher:
         self._timeout = batch_wait_timeout_s
         self._continuous = continuous
         # LEAF lock (see module docstring): queue + counters only.
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: leaf
         self._queue: deque = deque()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None

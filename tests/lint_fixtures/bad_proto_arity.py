@@ -1,0 +1,22 @@
+# protocheck: role=head
+# protocheck-with: bad_proto_arity_peer.py
+"""RTL502 bad fixture: the two-module sender/handler arity-drift case.
+Each side is legal against the catalog in isolation — lease_req allows
+4..5 elements — but the handler reads the optional opts element with no
+len() guard while the companion worker ships the 4-element form, and a
+widened kill tuple exceeds the catalog outright."""
+
+from ray_tpu._private import protocol
+
+
+class HeadLike:
+    def handle(self, msg):
+        tag = msg[0]
+        if tag == "lease_req":  # EXPECT: RTL502
+            rid, res, n = msg[1], msg[2], msg[3]
+            opts = msg[4]
+            return rid, res, n, opts
+        return None
+
+    def stop(self, conn, wid):
+        protocol.send(conn, ("kill", wid, 0))  # EXPECT: RTL502

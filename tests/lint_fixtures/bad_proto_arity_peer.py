@@ -1,0 +1,19 @@
+# protocheck: role=worker
+"""Companion worker module for bad_proto_arity.py: legally sends the
+SHORT 4-element lease_req form (opts is optional in the catalog) — the
+drift only exists across the two modules.  Also handles the head's kill
+so the widened-send case stays an arity finding, not a liveness one."""
+
+
+class WorkerLike:
+    def ask(self, rid):
+        self._send(("lease_req", rid, {"CPU": 1.0}, 2))
+
+    def _send(self, msg):
+        return msg
+
+    def reader(self, msg):
+        tag = msg[0]
+        if tag == "kill":
+            return True
+        return None

@@ -1,0 +1,21 @@
+# protocheck: role=objsrv
+"""RTL503 bad fixture: a capability-gated verb sent with no caps
+membership test anywhere on the path into the sending function — an old
+peer that never advertised fetch_range would silently ignore it and
+desync the stream (the PR 3/6/7 "never probe an old peer"
+convention)."""
+
+from ray_tpu._private import protocol
+
+
+class PullerLike:
+    def fetch(self, conn, name, length):
+        protocol.send(conn, ("fetch_range", name, 0, length))  # EXPECT: RTL503
+        return protocol.recv(conn)
+
+    def serve(self, conn, store):
+        msg = protocol.recv(conn)
+        if msg[0] == "fetch_range":
+            _tag, name, off, length = msg
+            return store.attach(name), off, length
+        return None

@@ -1,0 +1,17 @@
+# protocheck: stands-for=config.py
+# protocheck-with: bad_proto_knob_peer.py
+"""RTL504 bad fixture (config half): a worker-relevant knob that rides
+neither _worker_config_env nor an exemption marker.  The companion
+stands for runtime.py."""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Config:
+    lease_slots: int = 8  # EXPECT: RTL504
+    object_pool_size: int = 4
+    # protocheck: head-only -- the idle-worker reaper runs in the head
+    idle_worker_timeout_s: float = 300.0
+    # protocheck: head-only  # EXPECT: RTL500
+    prestart_workers: int = 0

@@ -1,0 +1,34 @@
+# protocheck: role=worker
+"""RTL505 bad fixture: the historical PutRegistry convention — its
+``_lock`` is a documented independent LEAF, so acquiring ANY lock under
+it (here through one level of call resolution) is a violation the
+runtime lockcheck would only catch if the path executed; plus a plain
+undeclared nesting edge between two unannotated locks."""
+
+import threading
+
+
+class PutRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-order: leaf
+        self._evict_lock = threading.Lock()
+
+    def write(self, name):
+        with self._lock:
+            self._teardown(name)  # EXPECT: RTL505
+            return True
+
+    def _teardown(self, name):
+        with self._evict_lock:
+            return name
+
+
+class Owner:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._table_lock = threading.Lock()
+
+    def release(self):
+        with self.lock:
+            with self._table_lock:  # EXPECT: RTL505
+                return 1

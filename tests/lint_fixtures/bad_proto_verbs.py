@@ -1,0 +1,24 @@
+# protocheck: role=head
+# protocheck-with: bad_proto_verbs_peer.py
+"""RTL501/RTL500 bad fixture: a typo'd verb, a verb sent from the wrong
+role, a reasonless suppression, and a dead handler (the companion worker
+module never sends lease_renew)."""
+
+from ray_tpu._private import protocol
+
+
+class HeadLike:
+    def reply(self, conn, rid):
+        protocol.send(conn, ("repyl", rid, None))  # EXPECT: RTL501
+
+    def pressure(self, conn):
+        protocol.send(conn, ("oom_pressure", 0.5))  # EXPECT: RTL501
+
+    def relay(self, conn):
+        protocol.send(conn, ("segment", 1, True, b""))  # noqa: RTL501  # EXPECT: RTL500
+
+    def handle(self, msg):
+        tag = msg[0]
+        if tag == "lease_renew":  # EXPECT: RTL501
+            return msg[1]
+        return None

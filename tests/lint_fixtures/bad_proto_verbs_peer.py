@@ -1,0 +1,8 @@
+# protocheck: role=worker
+"""Companion worker module for bad_proto_verbs.py: deliberately sends
+NOTHING, so the head fixture's lease_renew arm is provably dead."""
+
+
+class WorkerLike:
+    def idle(self):
+        return None

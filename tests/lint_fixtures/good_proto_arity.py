@@ -1,0 +1,20 @@
+# protocheck: role=head
+# protocheck-with: good_proto_arity_peer.py
+"""RTL502 good fixture: the optional lease_req opts element is read
+behind a len() guard, so the companion's short form is safe; kill is
+sent at its catalog arity."""
+
+from ray_tpu._private import protocol
+
+
+class HeadLike:
+    def handle(self, msg):
+        tag = msg[0]
+        if tag == "lease_req":
+            rid, res, n = msg[1], msg[2], msg[3]
+            opts = msg[4] if len(msg) > 4 else None
+            return rid, res, n, opts
+        return None
+
+    def stop(self, conn):
+        protocol.send(conn, ("kill",))

@@ -1,0 +1,19 @@
+# protocheck: role=worker
+"""Companion worker module for good_proto_arity.py: both legal
+lease_req forms, and the kill handler that keeps the head's send
+live."""
+
+
+class WorkerLike:
+    def ask(self, rid, opts):
+        self._send(("lease_req", rid, {"CPU": 1.0}, 2))
+        self._send(("lease_req", rid, {"CPU": 1.0}, 2, opts))
+
+    def _send(self, msg):
+        return msg
+
+    def reader(self, msg):
+        tag = msg[0]
+        if tag == "kill":
+            return True
+        return None

@@ -1,0 +1,25 @@
+# protocheck: role=objsrv
+"""RTL503 good fixture: the caps membership test lexically guards the
+gated verb's send path, and a helper reached only from the gated
+function inherits the gate (one level of intra-module call
+resolution)."""
+
+from ray_tpu._private import protocol
+
+
+class PullerLike:
+    def fetch(self, conn, name, length, caps):
+        if "fetch_range" in caps:
+            return self._fetch_striped(conn, name, length)
+        return None
+
+    def _fetch_striped(self, conn, name, length):
+        protocol.send(conn, ("fetch_range", name, 0, length))
+        return protocol.recv(conn)
+
+    def serve(self, conn, store):
+        msg = protocol.recv(conn)
+        if msg[0] == "fetch_range":
+            _tag, name, off, length = msg
+            return store.attach(name), off, length
+        return None

@@ -1,0 +1,16 @@
+# protocheck: stands-for=config.py
+# protocheck-with: good_proto_knob_peer.py
+"""RTL504 good fixture (config half): every field is plumbed, aliased,
+or exempted with a reason."""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Config:
+    lease_slots: int = 8
+    object_pool_size: int = 4
+    # protocheck: head-only -- the idle-worker reaper runs in the head
+    idle_worker_timeout_s: float = 300.0
+    # protocheck: env-alias RAY_TPU_POOL_BYTES -- legacy spelling
+    shm_pool_bytes: int = 1

@@ -1,0 +1,32 @@
+# protocheck: role=worker
+"""RTL505 good fixture: the leaf registry acquires nothing under its
+lock (teardown work happens after release), and the owner's inner lock
+is a declared leaf — nesting INTO a leaf is the convention."""
+
+import threading
+
+
+class PutRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-order: leaf
+        self._evict_lock = threading.Lock()
+
+    def write(self, name):
+        with self._lock:
+            entry = name
+        return self._teardown(entry)
+
+    def _teardown(self, name):
+        with self._evict_lock:
+            return name
+
+
+class Owner:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._table_lock = threading.Lock()  # lock-order: leaf
+
+    def release(self):
+        with self.lock:
+            with self._table_lock:
+                return 1

@@ -1,0 +1,21 @@
+# protocheck: role=head
+# protocheck-with: good_proto_verbs_peer.py
+"""RTL501 good fixture: catalog verbs from the right role, a live
+handler (the companion sends lease_renew), and a suppression that
+carries its reason."""
+
+from ray_tpu._private import protocol
+
+
+class HeadLike:
+    def reply(self, conn, rid):
+        protocol.send(conn, ("reply", rid, None))
+
+    def relay(self, conn):
+        protocol.send(conn, ("segment", 1, True, b""))  # noqa: RTL501 -- interop shim: replays a captured agent frame in the relay test
+
+    def handle(self, msg):
+        tag = msg[0]
+        if tag == "lease_renew":
+            return msg[1]
+        return None

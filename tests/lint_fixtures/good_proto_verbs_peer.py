@@ -1,0 +1,17 @@
+# protocheck: role=worker
+"""Companion worker module for good_proto_verbs.py: sends lease_renew
+(keeping the head arm live) and handles the head's reply verb."""
+
+
+class WorkerLike:
+    def renew(self, wids):
+        self._send(("lease_renew", list(wids)))
+
+    def _send(self, msg):
+        return msg
+
+    def reader(self, msg):
+        tag = msg[0]
+        if tag == "reply":
+            return msg[2]
+        return None

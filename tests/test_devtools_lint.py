@@ -1,15 +1,22 @@
 """Framework linter tests: every rule's good/bad fixture pair, exact rule
-IDs and line numbers, suppression syntax, and the CLI contract."""
+IDs and line numbers, suppression syntax, and the CLI contract.
+
+The EXPECT harness covers BOTH analyzers: per-file lint findings plus
+whole-program protocheck findings (a proto fixture names its companion
+modules with `# protocheck-with: other.py`, so the two-module cases —
+sender/handler arity drift, knob plumbing — analyze as one program with
+findings attributed per file)."""
 
 import os
 import re
 import subprocess
 import sys
 
-from ray_tpu.devtools import lint
+from ray_tpu.devtools import lint, protocheck
 
 FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 _EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z0-9]+)")
+_WITH_RE = re.compile(r"#\s*protocheck-with:\s*([\w.,\s]+)")
 
 
 def _expected_findings(path):
@@ -20,6 +27,28 @@ def _expected_findings(path):
             for rule in _EXPECT_RE.findall(line):
                 out.add((lineno, rule))
     return out
+
+
+def _companions(path):
+    """Fixture files this one analyzes WITH (the whole-program cases)."""
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in list(f)[:10]:
+            m = _WITH_RE.search(line)
+            if m:
+                out.extend(
+                    os.path.join(FIXTURE_DIR, c.strip())
+                    for c in m.group(1).split(",") if c.strip())
+    return out
+
+
+def _fixture_findings(path):
+    """{(line, rule)} from both analyzers, attributed to this file."""
+    got = {(f.line, f.rule) for f in lint.lint_file(path)}
+    got |= {(f.line, f.rule)
+            for f in protocheck.check_paths([path] + _companions(path))
+            if f.path == path}
+    return got
 
 
 def _fixture_files():
@@ -40,8 +69,9 @@ def test_every_rule_has_a_firing_fixture():
     covered = set()
     for path in _fixture_files():
         covered.update(rule for _, rule in _expected_findings(path))
-    assert covered == set(lint.RULES), (
-        f"rules without a bad fixture: {set(lint.RULES) - covered}")
+    all_rules = set(lint.RULES) | set(protocheck.RULES)
+    assert covered == all_rules, (
+        f"rules without a bad fixture: {all_rules - covered}")
 
 
 def test_fixture_findings_match_exactly():
@@ -49,7 +79,7 @@ def test_fixture_findings_match_exactly():
     rule ID on the right line, and NOTHING else fires (good files pin the
     negative space)."""
     for path in _fixture_files():
-        got = {(f.line, f.rule) for f in lint.lint_file(path)}
+        got = _fixture_findings(path)
         want = _expected_findings(path)
         assert got == want, (
             f"{os.path.basename(path)}: findings {sorted(got)} != "
@@ -59,7 +89,7 @@ def test_fixture_findings_match_exactly():
 def test_good_fixtures_are_silent():
     for path in _fixture_files():
         if os.path.basename(path).startswith("good_"):
-            assert lint.lint_file(path) == [], path
+            assert _fixture_findings(path) == set(), path
 
 
 def test_noqa_requires_rule_id():
@@ -135,3 +165,27 @@ def test_main_list_rules(capsys):
     out = capsys.readouterr().out
     for rule_id in lint.RULES:
         assert rule_id in out
+
+
+def test_main_doc_renders_rule_table(capsys):
+    assert lint.main(["--doc"]) == 0
+    out = capsys.readouterr().out
+    assert "| rule | what it catches |" in out
+    for rule_id in lint.RULES:
+        assert rule_id in out
+
+
+def test_main_select_runs_rules_individually(capsys):
+    bad = os.path.join(FIXTURE_DIR, "bad_lock_acquire.py")
+    # The file fires RTL401; selecting it keeps the finding...
+    assert lint.main(["--select=RTL401", bad]) == 1
+    assert "RTL401" in capsys.readouterr().out
+    # ...selecting a different rule silences the run (exit 0)...
+    assert lint.main(["--select=RTL301", bad]) == 0
+    assert capsys.readouterr().out.strip() == ""
+    # ...and a family prefix selects the whole family.
+    assert lint.main(["--select=RTL4", bad]) == 1
+    assert "RTL401" in capsys.readouterr().out
+    # A selector matching NO rule is an error, not a silent green run.
+    assert lint.main(["--select=RTL9", bad]) == 2
+    assert "matches no rule" in capsys.readouterr().err

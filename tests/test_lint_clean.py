@@ -1,12 +1,14 @@
-"""Lint-cleanliness gate: the shipped tree must carry zero un-suppressed
-framework-lint findings, so a regression fails plain `pytest tests/`
-without a separate CI job (the `python -m ray_tpu.devtools.lint ray_tpu/`
-CLI is the same engine)."""
+"""Cleanliness gates: the shipped tree must carry zero un-suppressed
+framework-lint findings AND zero un-suppressed protocheck findings, so a
+regression fails plain `pytest tests/` without a separate CI job (the
+`python -m ray_tpu.devtools.lint` / `...protocheck` CLIs are the same
+engines)."""
 
 import os
+import time
 
 import ray_tpu
-from ray_tpu.devtools import lint
+from ray_tpu.devtools import lint, protocheck
 
 PKG_DIR = os.path.dirname(os.path.abspath(ray_tpu.__file__))
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -30,3 +32,21 @@ def test_test_tree_is_lint_clean():
     # the documented `lint ray_tpu/ tests/` invocation — must be clean.
     findings = lint.lint_paths([TESTS_DIR])
     assert findings == [], _format(findings)
+
+
+def test_tree_is_protocheck_clean_within_budget():
+    """The whole-program conformance gate: `python -m
+    ray_tpu.devtools.protocheck ray_tpu/ tests/` must exit 0 on the
+    shipped tree (every suppression carrying a reason — a reasonless one
+    is itself a finding, RTL500), and the analysis must stay inside its
+    10 s budget so the gate is cheap enough to keep in tier-1."""
+    start = time.monotonic()
+    findings = protocheck.check_paths([PKG_DIR, TESTS_DIR])
+    elapsed = time.monotonic() - start
+    assert findings == [], (
+        "protocheck found un-suppressed whole-program findings (fix "
+        "them, or suppress with '# noqa: <RULE-ID> -- reason'):\n"
+        + _format(findings))
+    assert elapsed < 10.0, (
+        f"protocheck took {elapsed:.1f}s over ray_tpu/ + tests/ — the "
+        f"tier-1 gate budget is 10s")

@@ -430,6 +430,11 @@ def _legacy_put_server(store, delay):
     return listener, stopped
 
 
+@pytest.mark.slow  # ~8s perf A/B — the put-side twin of the pull-side
+# 4x64MB A/B already in the slow lane (PR 9); striped-put CORRECTNESS
+# (byte-identical reassembly, O(1) control messages, counters) keeps
+# sub-second tier-1 reps in this file.  Buys back the new protocheck
+# gate + seeded-mutation battery's tier-1 time.
 def test_four_concurrent_puts_2x_over_legacy_baseline(shm_store):
     """Acceptance micro: 4 concurrent 48 MB puts over a paced link —
     the striped/pooled direct-put path must complete ≥2x faster than the

@@ -42,7 +42,7 @@ def test_batch_singleton_and_empty_collapse():
     assert protocol.recv(b) == ("result", b"t", True, [], {})
     # Empty list: nothing on the wire at all.
     protocol.send_batch(a, [])
-    protocol.send(a, ("sentinel",))
+    protocol.send(a, ("sentinel",))  # noqa: RTL501 -- deliberate non-catalog verb: proves the empty batch wrote nothing ahead of it
     assert protocol.recv(b) == ("sentinel",)
     a.close()
     b.close()
