@@ -315,6 +315,35 @@ class Config:
     # protocheck: head-only -- agent-process knob, read from the agent's own environment (launcher/operator-set)
     agent_reconnect: bool = True
 
+    # --- Elastic pods (preemption-aware drain + spot slice pools;
+    # reference: the GCS DrainNode RPC + raylet drain,
+    # gcs_node_manager.h / node_manager.cc HandleDrainRaylet — node
+    # removal as a first-class protocol rather than a death). ---
+    # Master switch for the drain protocol: scale-down and preemption
+    # notices route through ``Runtime.drain_node`` (stop placements,
+    # revoke leases, force-checkpoint restartable actors to a surviving
+    # store, migrate small sole-copy objects) before the node goes
+    # away.  Off = the legacy hard-remove path, byte-identical, with
+    # every elastic counter (preemptions / drains_completed /
+    # drain_timeouts / objects_migrated) zero.
+    elastic_drain: bool = True
+    # Wall-clock budget for one node drain (the spot warning window —
+    # e.g. ~30s on GCE preemptible TPUs).  Past it the drain falls
+    # through to the existing hard-kill recovery: lineage reconstructs
+    # what migration did not cover.
+    drain_deadline_s: float = 10.0
+    # Sole-copy objects homed on a draining node at most this big are
+    # migrated (pulled and re-homed on the head's surviving store);
+    # larger ones stay behind as lineage-reconstruction candidates —
+    # re-executing the producer beats moving a multi-GB value through
+    # a closing warning window.
+    drain_migrate_max_bytes: int = 64 * 1024 * 1024
+    # Spot pool fallback: after this many observed preemptions of one
+    # spot node type, the autoscaler stops preferring that type and
+    # launches its on-demand fallback instead (per-type accounting in
+    # StandardAutoscaler).
+    spot_fallback_threshold: int = 2
+
     # --- OOM memory monitor (reference: src/ray/common/memory_monitor.h
     # + worker_killing_policy_group_by_owner.cc: kill the newest
     # retriable task's worker before the kernel OOM-killer takes the

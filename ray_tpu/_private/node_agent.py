@@ -22,7 +22,9 @@ RAY_TPU_AUTHKEY / RAY_TPU_AGENT_* env vars (see cluster_utils.Cluster).
 Wire contract: the agent-plane verbs (``agent_ready``/``agent_ack``,
 ``spawn_worker``/``kill_worker``/``kill_worker_hard``,
 ``read_segment``/``segment``, ``unlink_segment``, ``oom_pressure``,
-``worker_logs``, ``shutdown``) are declared in ``protocol.VERBS`` and
+``worker_logs``, ``shutdown``, and the elastic-drain pair
+``preempt_notice``/``drain_node`` — caps family ``drain_caps``,
+advertised both ways) are declared in ``protocol.VERBS`` and
 machine-checked against this module's send/handle sites by
 ``python -m ray_tpu.devtools.protocheck``.
 """
@@ -86,6 +88,14 @@ class NodeAgent:
         self.head_config: Dict = {}
         self._handshake_done = threading.Event()
         self._stopped = False
+        # Elastic drain state: a preemption notice (SIGTERM with
+        # RAY_TPU_PREEMPT_SIGTERM=1, SIGUSR1, provider poll, chaos
+        # "preempt") starts ONE self-drain; _drain_done releases it when
+        # the head's drain_node ack lands (or the deadline expires and
+        # the plug pulls).
+        self._drain_lock = threading.Lock()
+        self._draining = False
+        self._drain_done = threading.Event()
         # Object server: direct chunked pulls from this node's store
         # (reference: the per-node object manager's transfer port).
         host = os.environ.get("RAY_TPU_AGENT_LISTEN_HOST", "127.0.0.1")
@@ -108,6 +118,21 @@ class NodeAgent:
                          name="agent-memmon").start()
         threading.Thread(target=self._log_tailer, daemon=True,
                          name="agent-logmon").start()
+        # Provider-poll preemption notice (the GCE metadata-server
+        # analog): when RAY_TPU_PREEMPT_FILE names a path, its
+        # appearance is the warning — self-drain starts the moment the
+        # poller sees it.  Off (no thread) when unset.
+        if os.environ.get("RAY_TPU_PREEMPT_FILE"):
+            threading.Thread(target=self._preempt_poller, daemon=True,
+                             name="agent-preempt-poll").start()
+
+    def _preempt_poller(self):
+        path = os.environ["RAY_TPU_PREEMPT_FILE"]
+        while not self._stopped:
+            if os.path.exists(path):
+                self.notice_preemption("provider_poll")
+                return
+            time.sleep(0.25)
 
     def _log_tailer(self):
         """Ship this node's worker log lines to the head in 0.5s batches
@@ -222,6 +247,10 @@ class NodeAgent:
             # pulls) to peers that declare it, so an old agent that
             # would silently ignore the verb is never probed with it.
             "object_caps": list(object_transfer.CAPS),
+            # Agent-plane verbs beyond the original set: the head sends
+            # drain_node only to agents declaring it (old agents fall to
+            # the legacy hard teardown).
+            "agent_caps": ["drain_node", "preempt_notice"],
             "pid": os.getpid(),
             "hostname": os.uname().nodename,
             # Failover re-registration: a restarted head re-binds this
@@ -308,6 +337,13 @@ class NodeAgent:
                 # Owner freed an object homed here (the owner-driven
                 # deletion of local_object_manager.h:41).
                 self.store.unlink(msg[1], msg[2])
+            elif tag == "drain_node":
+                # The head drained this node (scale-down order, or the
+                # ack to our own preempt_notice): release any waiting
+                # self-drain and exit cleanly — workers terminated,
+                # listeners closed, a zero-surprise departure.
+                self._drain_done.set()
+                break
             elif tag == "shutdown":
                 break
         self.shutdown()
@@ -340,6 +376,42 @@ class NodeAgent:
                 # shutdown(), which terminates them — the legacy outage.
                 pass
             return False
+
+    def notice_preemption(self, source: str):
+        """Preemption-notice entry point (signal handlers, the provider
+        poller, chaos ``preempt``): hand off to a thread — the drain
+        blocks on the head, and signal context must not."""
+        threading.Thread(target=self._self_drain, args=(source,),
+                         daemon=True, name="agent-self-drain").start()
+
+    def _self_drain(self, source: str):
+        """Deadline-bounded self-drain before the plug pulls: ask the
+        head to drain this node (``preempt_notice``), wait for its
+        ``drain_node`` release, then exit.  Degrades to the legacy
+        immediate exit when the drain protocol is off, the head never
+        advertised the verbs, or the deadline expires — exactly the
+        no-warning preemption the hard-kill recovery already covers."""
+        with self._drain_lock:
+            if self._draining or self._stopped:
+                return
+            self._draining = True
+        # Chaos syncpoint: "agent:preempt:n" rules kill THIS process
+        # mid-warning-window — the notice-then-plug-pulled-early drill.
+        recovery.syncpoint("preempt")
+        deadline_s = float(self._failover_knob("RAY_TPU_DRAIN_DEADLINE_S",
+                                               "drain_deadline_s", 10.0))
+        on = self._failover_knob("RAY_TPU_ELASTIC_DRAIN",
+                                 "elastic_drain", True)
+        head_drain_caps = tuple(self.head_config.get("drain_caps") or ())
+        if on and self.conn is not None \
+                and "preempt_notice" in head_drain_caps:
+            try:
+                self._send(("preempt_notice", deadline_s, source))
+                self._drain_done.wait(deadline_s)
+            except Exception:
+                pass
+        self.shutdown()
+        os._exit(0)
 
     def _terminate_workers(self):
         """terminate -> wait -> kill, as in shutdown(): a TPU worker
@@ -457,7 +529,21 @@ def main():
                                f"/tmp/ray_tpu_node_{os.getpid()}"),
         labels=json.loads(os.environ.get("RAY_TPU_AGENT_LABELS", "{}")),
     )
-    signal.signal(signal.SIGTERM, lambda *_: agent.shutdown() or sys.exit(0))
+    # Preemption notice sources (elastic pods): SIGUSR1 is always a
+    # notice (the chaos harness's graceful ``preempt`` and the
+    # launcher's forwarded warning); SIGTERM becomes one only under
+    # RAY_TPU_PREEMPT_SIGTERM=1 — what an operator sets on a real spot
+    # VM, where SIGTERM IS the warning — because the test/teardown
+    # path SIGTERMs agents for plain shutdown.
+    signal.signal(signal.SIGUSR1,
+                  lambda *_: agent.notice_preemption("sigusr1"))
+    if os.environ.get("RAY_TPU_PREEMPT_SIGTERM", "").lower() in (
+            "1", "true", "yes"):
+        signal.signal(signal.SIGTERM,
+                      lambda *_: agent.notice_preemption("sigterm"))
+    else:
+        signal.signal(signal.SIGTERM,
+                      lambda *_: agent.shutdown() or sys.exit(0))
     agent.connect()
     agent.serve()
 

@@ -235,9 +235,20 @@ VERBS = {
     "job_logs": Verb(("client",), ("head",), (3, 3), "job API: logs"),
     "job_stop": Verb(("client",), ("head",), (3, 3), "job API: stop"),
     "job_list": Verb(("client",), ("head",), (2, 2), "job API: list"),
-    "actor_checkpoint": Verb(("worker",), ("head",), (3, 3),
+    "actor_checkpoint": Verb(("worker",), ("head",), (3, 4),
                              "latest __ray_save__ descriptor from a "
-                             "restartable actor"),
+                             "restartable actor; the optional 4th "
+                             "element marks a drain-FORCED reply (parts "
+                             "the head re-homes on a surviving store, "
+                             "or None for a hookless actor) and is what "
+                             "releases the drain's rendezvous"),
+    "checkpoint_now": Verb(("head",), ("worker",), (2, 2),
+                           "drain: force an immediate __ray_save__ of "
+                           "the named actor, shipped as parts so the "
+                           "head re-homes it on a surviving store; the "
+                           "worker always replies actor_checkpoint "
+                           "(None without the hook) so the drain never "
+                           "stalls"),
     # -- lease plane (decentralized dispatch) ------------------------------
     "lease_req": Verb(("worker", "client"), ("head",), (4, 5),
                       "worker/client asks for leases; optional opts "
@@ -309,6 +320,22 @@ VERBS = {
     "oom_pressure": Verb(("agent",), ("head",), (2, 2),
                          "node memory fraction crossed the monitor "
                          "threshold"),
+    # -- elastic pods: preemption-aware drain (caps family "drain_caps":
+    # agents advertise it in agent_ready, the head advertises it back in
+    # the agent_ack config dict — the PR 3 "never probe an old peer"
+    # convention) --------------------------------------------------------
+    "preempt_notice": Verb(("agent",), ("head",), (3, 3),
+                           "agent got a preemption warning (SIGTERM / "
+                           "provider poll / chaos preempt): drain this "
+                           "node within deadline_s, then release it "
+                           "with drain_node", caps="drain_caps"),
+    "drain_node": Verb(("head",), ("agent",), (3, 3),
+                       "head -> agent: node drained (leases revoked, "
+                       "actors checkpointed to a surviving store, small "
+                       "sole-copy objects migrated) — finish up and "
+                       "exit cleanly; doubles as the preempt_notice "
+                       "ack and the graceful scale-down order",
+                       caps="drain_caps"),
     "worker_logs": Verb(("agent",), ("head",), (2, 2),
                         "batched worker stdout/stderr lines"),
     # -- handshakes / failover ---------------------------------------------

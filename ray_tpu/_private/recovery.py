@@ -23,7 +23,12 @@ Three pieces, kept dependency-free so every process tier can import it
   chaos harness (``ray_tpu.chaos``) arms in-process, and that
   ``RAY_TPU_CHAOS`` env rules arm in spawned workers/agents for
   deterministic mid-operation kills.  Never active unless explicitly
-  opted in.
+  opted in.  Points: dispatch/result/lease_grant (head), exec_start
+  (worker), pull_chunk (every transfer chunk), agent_msg (agent
+  control messages), snapshot/dispatch (standalone head), and
+  ``preempt`` — fired at the start of an agent's self-drain, so an
+  ``agent:preempt:1`` rule models the warning window getting yanked
+  mid-drain (notice received, plug pulled early).
 """
 
 from __future__ import annotations

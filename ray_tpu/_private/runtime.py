@@ -33,7 +33,7 @@ import threading
 import time
 import weakref
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import object_transfer, protocol, recovery, \
@@ -346,6 +346,7 @@ class NodeState:
     __slots__ = (
         "node_id", "resources", "available", "labels", "idle_workers",
         "all_workers", "tpu_free", "alive", "agent", "store_id",
+        "draining",
     )
 
     def __init__(self, node_id, resources, labels=None, agent=None,
@@ -363,8 +364,15 @@ class NodeState:
         # object store; in-process test nodes share the head's store.
         self.agent: Optional["AgentHandle"] = agent
         self.store_id = store_id
+        # Drain in progress (elastic pods): the node is on its way out —
+        # no new placements of any kind (can_fit refuses), existing work
+        # finishes or is stolen/revoked (reference: the raylet's drain
+        # state under the GCS DrainNode RPC).
+        self.draining = False
 
     def can_fit(self, req: Dict[str, float]) -> bool:
+        if self.draining:
+            return False
         return all(self.available.get(k, 0.0) >= v - 1e-9
                    for k, v in req.items())
 
@@ -555,6 +563,32 @@ class Runtime:
         self.reconnected_nodes = 0
         self.reregistered_workers = 0
         self.adopted_actors = 0
+        # Elastic-pod counters (all zero while elastic_drain is off —
+        # pinned by tests): preemptions = preempt_notice messages
+        # received from agents (spot warning windows); drains_completed /
+        # drain_timeouts = drain_node() outcomes (a timeout falls
+        # through to hard-kill recovery); objects_migrated = sole-copy
+        # objects pulled off a draining node and re-homed on the head's
+        # surviving store; autoscaler_errors lives autoscaler-side
+        # (StandardAutoscaler.stats()) next to these.
+        self.preemptions = 0
+        self.drains_completed = 0
+        self.drain_timeouts = 0
+        self.objects_migrated = 0
+        # Drain rendezvous: aid -> Event set when the forced
+        # ("checkpoint_now", aid) round-trips as an actor_checkpoint;
+        # node_id -> [done_event, outcome, deadline_abs] for that
+        # node's in-flight drain (a second drain_node call — scale-down
+        # racing a preemption notice — waits the FIRST drain out past
+        # its own deadline and returns its real outcome, instead of
+        # failing into a hard kill mid-drain or mislabeling a timeout
+        # as success).
+        self._drain_ck_events: Dict[bytes, threading.Event] = {}
+        self._node_drains: Dict[NodeID, list] = {}
+        # Driver-side pubsub listeners: topic -> callbacks fired (outside
+        # the lock) when a worker "event" lands — the serve controller's
+        # scale events wake the autoscaler loop through this.
+        self._event_listeners: Dict[str, List] = {}
         # Reconcile state for a restarted head: restored-but-unclaimed
         # nodes/actors/leases wait until _failover_grace_until for their
         # surviving owners to re-register; the grace timer then revokes
@@ -860,6 +894,248 @@ class Runtime:
             except Exception:
                 pass
         # Death handling proceeds via conn EOF in the IO loop.
+
+    # ------------------------------------------------------ elastic drain --
+    def drain_node(self, node_id, deadline_s=None,
+                   reason: str = "scale_down") -> bool:
+        """Graceful, deadline-bounded node removal — the DrainNode
+        protocol (reference: gcs_node_manager DrainNode RPC + the
+        raylet's drain state).  Within the deadline: (1) stop new
+        placements (``node.draining`` — can_fit refuses), (2) revoke the
+        node's outbound leases through the PR 6 revocation path so
+        holders reroute now, (3) steal queued-but-unstarted head tasks
+        back and wait for the rest to finish, (4) force-checkpoint its
+        restartable actors onto a SURVIVING store (``checkpoint_now`` →
+        parts-shipped ``__ray_save__`` descriptors re-homed here — a
+        checkpoint homed on the dying node would be dropped at restart),
+        (5) migrate small sole-copy objects off the node (pull + re-home
+        on the head store; larger ones stay lineage-reconstruction
+        candidates), then (6) release the agent with ``drain_node`` so
+        it exits cleanly.  Returns True when every phase landed inside
+        the deadline (``drains_completed``); False on refusal or
+        timeout (``drain_timeouts`` — the caller falls through to the
+        existing hard-kill recovery, which is always correct, just
+        costlier)."""
+        if isinstance(node_id, str):
+            node_id = NodeID(bytes.fromhex(node_id))
+        if not self.config.elastic_drain:
+            return False
+        if deadline_s is None:
+            deadline_s = self.config.drain_deadline_s
+        deadline = time.monotonic() + max(0.2, float(deadline_s))
+        entry = pending = None
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive or node is self.head_node:
+                return False
+            if node.draining:
+                pending = self._node_drains.get(node_id)
+            else:
+                node.draining = True
+                entry = [threading.Event(), False, deadline]
+                self._node_drains[node_id] = entry
+            agent = node.agent
+        if entry is None:
+            # Another drain is in flight (scale-down racing a preemption
+            # notice): wait for ITS conclusion — past its own deadline,
+            # not just ours (it concludes on its own schedule) — and
+            # return its REAL outcome, instead of failing into a hard
+            # kill mid-migration or mislabeling a timed-out drain as a
+            # success.  A missing entry means that drain already
+            # concluded; just let the caller terminate.
+            if pending is None:
+                return True
+            wait_s = max(float(deadline_s),
+                         pending[2] - time.monotonic()) + 10.0
+            if pending[0].wait(max(0.2, wait_s)):
+                return bool(pending[1])
+            return False  # wedged past its own deadline: hard fallback
+        try:
+            completed = self._drain_node_inner(node, agent, deadline,
+                                               deadline_s, reason)
+            entry[1] = completed
+            return completed
+        finally:
+            with self.lock:
+                self._node_drains.pop(node_id, None)
+            entry[0].set()
+
+    def _drain_node_inner(self, node, agent, deadline,
+                          deadline_s, reason) -> bool:
+        with self.lock:
+            for w in list(node.all_workers.values()):
+                if w.dead:
+                    continue
+                holder = w.client_lease
+                if holder is not None:
+                    # Leased-out worker: revoke exactly as node death
+                    # would — the holder retries/reroutes everything the
+                    # lease carried, but NOW, against a still-healthy
+                    # cluster, instead of at the kill.
+                    if not holder.dead \
+                            and self.config.decentralized_dispatch:
+                        self.lease_revocations += 1
+                        self._queue_send(holder, ("lease_revoke",
+                                                  [w.worker_id.hex()]))
+                    w.client_lease = None
+                    self._end_lease_locked(w)
+                elif w.actor_id is None and w.inflight:
+                    # Head-dispatched plain tasks: reclaim what has not
+                    # started; what is already running gets the rest of
+                    # the deadline to finish.
+                    stealable = [tid for tid, r in w.inflight.items()
+                                 if not r.is_actor_creation]
+                    if stealable:
+                        self._queue_send(w, ("steal", 0, stealable))
+            self._request_dispatch_locked()
+        idle_ok = self._drain_wait_idle(node, deadline)
+        ck_ok = self._drain_checkpoint_actors(node, deadline)
+        mig_ok = self._drain_migrate_objects(node, deadline)
+        completed = idle_ok and ck_ok and mig_ok
+        with self.lock:
+            if completed:
+                self.drains_completed += 1
+            else:
+                self.drain_timeouts += 1
+        if agent is not None and not agent.dead:
+            # Release the agent (it exits cleanly; sent even after a
+            # timeout — best effort beats nothing, and the hard-kill
+            # recovery covers whatever was left).  Caps-gated per the
+            # PR 3 convention: an old agent that would ignore the verb
+            # is never probed — its node falls to the legacy teardown.
+            agent_drain_caps = tuple(agent.info.get("agent_caps") or ())
+            if "drain_node" in agent_drain_caps:
+                try:
+                    agent.send(("drain_node", float(deadline_s), reason))
+                except Exception:
+                    pass
+        return completed
+
+    def _drain_wait_idle(self, node: NodeState, deadline: float) -> bool:
+        """Wait (bounded) for the node's plain workers to finish their
+        in-flight head-dispatched tasks; stolen tasks re-enqueue
+        elsewhere on their own."""
+        while time.monotonic() < deadline:
+            with self.lock:
+                busy = any(w.inflight and not w.dead
+                           and w.actor_id is None
+                           for w in node.all_workers.values())
+            if not busy:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def _drain_checkpoint_actors(self, node: NodeState,
+                                 deadline: float) -> bool:
+        """Force an immediate ``__ray_save__`` of every restartable
+        actor on the node; the worker ships parts the head re-homes on
+        its own store (actor_checkpoint handler).  True when every ack
+        landed before the deadline."""
+        targets = []
+        with self.lock:
+            if not self.config.recovery:
+                return True
+            for aid, actor in self.actors.items():
+                w = actor.worker
+                if (actor.status == ALIVE and w is not None and not w.dead
+                        and w.node is node and actor.restarts_left != 0):
+                    ev = threading.Event()
+                    self._drain_ck_events[aid] = ev
+                    targets.append((aid, w, ev))
+            for aid, w, _ev in targets:
+                self._queue_send(w, ("checkpoint_now", aid))
+        ok = True
+        for aid, _w, ev in targets:
+            left = deadline - time.monotonic()
+            if left <= 0 or not ev.wait(left):
+                ok = False
+                break
+        with self.lock:
+            for aid, _w, _ev in targets:
+                self._drain_ck_events.pop(aid, None)
+        return ok
+
+    def _drain_migrate_objects(self, node: NodeState,
+                               deadline: float) -> bool:
+        """Migrate sole-copy objects homed in the draining node's store:
+        READY shm segments at most ``drain_migrate_max_bytes`` are
+        pulled over the data plane and re-homed on the head's surviving
+        store (descriptor updated in place — consumers resolving through
+        the owner directory see the new home; a stale cached pull falls
+        back to getparts, which serves the new copy).  Bigger objects
+        are left as lineage-reconstruction candidates.  True when every
+        candidate moved (or none needed to) before the deadline."""
+        cap = self.config.drain_migrate_max_bytes
+        if node.store_id == self.store_id:
+            # In-process test node: it shares the HEAD's store, which
+            # survives the node — nothing to move (and "migrating"
+            # head-homed segments onto themselves would be an
+            # unlink-recreate race).
+            return True
+        victims = []
+        with self.lock:
+            for oid, st in self.objects.items():
+                d = st.descr
+                # SPILLED counts too: the node's spill files die with it
+                # exactly like its shm pages, and the object server
+                # attaches them by (absolute) path just like any
+                # segment, so the same pull migrates both.
+                if (st.status == READY and d is not None
+                        and d[0] in (protocol.SHM, protocol.SPILLED)
+                        and len(d) > 3
+                        and d[3] == node.store_id and d[2] <= cap):
+                    st.pins += 1  # no free/spill mid-migration
+                    victims.append((oid, st, d))
+        if not victims:
+            return True
+
+        def _migrate_one(item) -> bool:
+            oid, st, d = item
+            moved = None
+            if time.monotonic() < deadline:
+                try:
+                    meta, bufs = self._fetch_parts(d)
+                    moved = self._store_parts_locally(oid, meta, bufs)
+                except Exception:
+                    moved = None  # reconstruction candidate
+            with self.lock:
+                st.pins -= 1
+                if moved is not None:
+                    if st.status == READY and st.descr is d:
+                        st.descr = moved
+                        # Frees now unlink the NEW home (head-local),
+                        # not the dead creator's pooled pages.
+                        st.creator = None
+                        self.objects_migrated += 1
+                    else:
+                        # Freed/re-written while we copied: drop the
+                        # orphan replica (spill-degraded copies are
+                        # plain files).
+                        try:
+                            if moved[0] == protocol.SPILLED:
+                                os.unlink(moved[1])
+                            else:
+                                self.shm.unlink(moved[1], moved[2],
+                                                reusable=False)
+                        except Exception:
+                            pass
+                self._maybe_free_locked(oid, st)
+            return moved is not None
+
+        # The pulls are independent and all race the SAME closing
+        # deadline: overlap them (the node's object server already
+        # serves concurrent getparts — PR 7's striped pull is built on
+        # it) so N victims cost ~N/pool transfers instead of a straight
+        # sum that turns a beatable spot warning window into a
+        # drain_timeout + avoidable reconstructions.
+        ok = True
+        with ThreadPoolExecutor(
+                max_workers=min(4, len(victims)),
+                thread_name_prefix="rtpu-drain-migrate") as pool:
+            for done in pool.map(_migrate_one, victims):
+                if not done:
+                    ok = False
+        return ok
 
     # -------------------------------------------------- runtime accessor --
     def is_worker(self):
@@ -1909,7 +2185,7 @@ class Runtime:
             return None
         for nid in self.node_order:
             node = self.nodes[nid]
-            if not node.alive:
+            if not node.alive or node.draining:
                 continue
             blocked = sum(1 for w in node.all_workers.values()
                           if w.blocked and not w.dead)
@@ -2300,6 +2576,18 @@ class Runtime:
                 str(self.config.lineage_bytes_budget),
             "RAY_TPU_ACTOR_CHECKPOINT_INTERVAL_S":
                 str(self.config.actor_checkpoint_interval_s),
+            # Elastic-pod knobs: the drain switch/deadline also reach
+            # node agents via the agent_ack config dict (their env wins
+            # per node); riding the worker env keeps the whole cluster
+            # on the driver's _system_config.
+            "RAY_TPU_ELASTIC_DRAIN":
+                "1" if self.config.elastic_drain else "0",
+            "RAY_TPU_DRAIN_DEADLINE_S":
+                str(self.config.drain_deadline_s),
+            "RAY_TPU_DRAIN_MIGRATE_MAX_BYTES":
+                str(self.config.drain_migrate_max_bytes),
+            "RAY_TPU_SPOT_FALLBACK_THRESHOLD":
+                str(self.config.spot_fallback_threshold),
             # Head-failover knobs: workers park + re-dial + re-register
             # across a head restart (the switch and both windows are
             # read in the worker process).
@@ -2584,7 +2872,15 @@ class Runtime:
                   "head_failover": self.config.head_failover,
                   "head_reconnect_grace_s":
                       self.config.head_reconnect_grace_s,
-                  "agent_reconnect": self.config.agent_reconnect}))
+                  "agent_reconnect": self.config.agent_reconnect,
+                  # Elastic pods: the drain verbs this head understands
+                  # (the agent gates preempt_notice on membership — an
+                  # old head is never probed) plus the knobs the agent
+                  # mirrors for its self-drain deadline.
+                  "drain_caps": (["preempt_notice", "drain_node"]
+                                 if self.config.elastic_drain else []),
+                  "elastic_drain": self.config.elastic_drain,
+                  "drain_deadline_s": self.config.drain_deadline_s}))
         threading.Thread(target=self._agent_reader, args=(conn, agent),
                          daemon=True, name="ray_tpu-rx-agent").start()
         with self.lock:
@@ -3921,7 +4217,8 @@ class Runtime:
 
     def _plan_pg_locked(self, pg) -> Optional[List[NodeState]]:
         alive = [self.nodes[nid] for nid in self.node_order
-                 if self.nodes[nid].alive]
+                 if self.nodes[nid].alive
+                 and not self.nodes[nid].draining]
         avail = {id(n): dict(n.available) for n in alive}
 
         def fits(n, b):
@@ -4024,6 +4321,22 @@ class Runtime:
                         if agent.node is not None else "")
             for wid, lines in msg[1]:
                 self._record_worker_lines(wid, lines, node=node_hex)
+        elif msg[0] == "preempt_notice":
+            # Spot/preemptible warning window: drain the node within the
+            # agent's deadline, then release it with drain_node so the
+            # agent exits before the plug pulls.  Off-thread — the drain
+            # waits on checkpoints and migration pulls, and this is the
+            # agent's reader thread.  With elastic_drain off the notice
+            # is ignored (the agent also never sends one then: the head
+            # withheld drain_caps in agent_ack) and the node death rides
+            # the legacy hard-kill path with every counter zero.
+            if self.config.elastic_drain and agent.node is not None:
+                with self.lock:
+                    self.preemptions += 1
+                threading.Thread(
+                    target=self.drain_node,
+                    args=(agent.node.node_id, msg[1], msg[2]),
+                    daemon=True, name="ray_tpu-drain").start()
 
     def _on_agent_death(self, agent: AgentHandle):
         """Node agent connection dropped: the node is gone (reference: GCS
@@ -4103,10 +4416,19 @@ class Runtime:
                         "worker_id": wid, "node_id": nid})
         elif tag == "event":
             # Generic worker->driver pubsub (reference: src/ray/pubsub/
-            # long-poll channels) — used by train session streaming.
+            # long-poll channels) — used by train session streaming and
+            # the serve controller's autoscale events.
             with self.lock:
                 self.events.setdefault(msg[1], deque(maxlen=10000)).append(
                     msg[2])
+                listeners = list(self._event_listeners.get(msg[1], ()))
+            for cb in listeners:
+                # Outside the lock: a listener (the autoscaler's wake)
+                # may take its own locks; it must only nudge, not block.
+                try:
+                    cb()
+                except Exception:
+                    pass
         elif tag == "xfer_stats":
             # Periodic data-plane counter DELTAS from a worker (pull
             # dedup, argument-prefetch hit/waste bytes) — aggregated
@@ -4710,16 +5032,52 @@ class Runtime:
             # worker: retain the descriptor for the next restart's
             # __ray_restore__; the superseded checkpoint's storage is
             # freed (checkpoints live outside the object table).
-            _, aid, descr = msg
+            aid, descr = msg[1], msg[2]
+            forced = len(msg) > 3 and bool(msg[3])
+            if descr is not None and descr[0] == protocol.PARTS:
+                # Drain-forced checkpoint: the worker shipped raw parts
+                # because its own store is about to die with the node —
+                # re-home the state on the HEAD's surviving store before
+                # retaining the descriptor (outside the lock: a big
+                # create_from_parts must not stall the reader).
+                try:
+                    descr = self._store_parts_locally(
+                        ObjectID.for_put(), descr[1], descr[2])
+                except Exception:
+                    descr = None
+            ck_ev = None
             with self.lock:
-                actor = self.actors.get(aid)
-                if actor is None or actor.status == DEAD:
-                    # Racing a death/GC: don't strand the bytes.
-                    self._free_checkpoint_locked(actor, descr)
-                else:
-                    old, actor.checkpoint = actor.checkpoint, descr
-                    if old is not None:
-                        self._free_checkpoint_locked(actor, old)
+                # A drain waiting on this actor's FORCED checkpoint is
+                # released even when the reply carries no state (hookless
+                # actor / failed save): the drain must not stall a full
+                # deadline on an actor that can never checkpoint.  Only
+                # the forced reply releases it — a racing periodic
+                # checkpoint (node-homed, mid-flight at drain start)
+                # must not end the wait before the re-homed state lands.
+                if forced:
+                    ck_ev = self._drain_ck_events.pop(aid, None)
+                if descr is not None:
+                    actor = self.actors.get(aid)
+                    if actor is None or actor.status == DEAD:
+                        # Racing a death/GC: don't strand the bytes.
+                        self._free_checkpoint_locked(actor, descr)
+                    elif self._ck_home_dying_locked(descr) \
+                            and actor.checkpoint is not None \
+                            and not self._ck_home_dying_locked(
+                                actor.checkpoint):
+                        # A periodic checkpoint homed on a DRAINING/dead
+                        # store must never supersede a safely-homed one:
+                        # the exec thread's post-method save races the
+                        # drain's forced re-homed checkpoint, and losing
+                        # that race would strand the restart on a store
+                        # that dies with the node.
+                        self._free_checkpoint_locked(None, descr)
+                    else:
+                        old, actor.checkpoint = actor.checkpoint, descr
+                        if old is not None:
+                            self._free_checkpoint_locked(actor, old)
+            if ck_ev is not None:
+                ck_ev.set()
 
     def submit_task_from_worker(self, spec: dict, submitter=None):
         """Nested submission: worker-generated task, driver-owned objects."""
@@ -5166,6 +5524,20 @@ class Runtime:
             # while the actor held the last slot pends forever.
             self._dispatch_locked()
 
+    def _ck_home_dying_locked(self, descr) -> bool:
+        """Whether a checkpoint descriptor is homed on a store that is
+        draining or already gone — state that dies with its node and
+        must not displace a safely-homed checkpoint."""
+        if descr is None or descr[0] not in (protocol.SHM,
+                                             protocol.SPILLED) \
+                or len(descr) <= 3:
+            return False
+        home = descr[3]
+        if home == self.store_id:
+            return False
+        node = self._node_for_store_locked(home)
+        return node is None or not node.alive or node.draining
+
     def _free_checkpoint_locked(self, actor: Optional[ActorState],
                                 descr=None):
         """Unlink a checkpoint's storage (the superseded one on refresh,
@@ -5395,6 +5767,27 @@ class Runtime:
             q.clear()
             return out
 
+    def add_event_listener(self, topic: str, cb) -> None:
+        """Fire ``cb()`` (no payload — consumers drain via poll_events)
+        whenever a worker publishes on ``topic``.  The autoscaler's
+        serve-event trigger: a controller scale event wakes the
+        reconcile loop immediately instead of waiting out its tick."""
+        with self.lock:
+            self._event_listeners.setdefault(topic, []).append(cb)
+
+    def remove_event_listener(self, topic: str, cb) -> None:
+        """Unregister a listener added by add_event_listener (a stopped
+        autoscaler must not stay referenced — and woken — forever)."""
+        with self.lock:
+            lst = self._event_listeners.get(topic)
+            if lst is not None:
+                try:
+                    lst.remove(cb)
+                except ValueError:
+                    pass
+                if not lst:
+                    self._event_listeners.pop(topic, None)
+
     def cancel_task(self, object_id: ObjectID, force=False):
         with self.lock:
             st = self.objects.get(object_id)
@@ -5584,6 +5977,15 @@ class Runtime:
                         out.append(dict(rec.requirements))
             for pg in self.pending_pgs:
                 out.extend(dict(b) for b in pg.bundles)
+            # Lease starvation: client lease requests PARKED for lack of
+            # capacity are demand the task queues never show — the
+            # holder's tasks wait inside its own DirectCaller, invisible
+            # here.  Feeding the parked shapes in is what lets the
+            # autoscaler scale for direct-path (leased) traffic too.
+            for p in self._pending_client_leases:
+                if not p["lessee"].dead:
+                    out.extend(dict(p["req"]) for _ in range(max(1,
+                                                                 p["n"])))
             return out
 
     def node_activity(self) -> List[Dict[str, Any]]:
@@ -5599,6 +6001,7 @@ class Runtime:
                     "alive": node.alive,
                     "is_head": node is self.head_node,
                     "busy": busy,
+                    "draining": node.draining,
                     "resources": dict(node.resources),
                     "available": dict(node.available),
                 })
@@ -5753,6 +6156,10 @@ class Runtime:
                 "reconnected_nodes": self.reconnected_nodes,
                 "reregistered_workers": self.reregistered_workers,
                 "adopted_actors": self.adopted_actors,
+                "preemptions": self.preemptions,
+                "drains_completed": self.drains_completed,
+                "drain_timeouts": self.drain_timeouts,
+                "objects_migrated": self.objects_migrated,
             }
 
     def list_nodes(self):

@@ -1246,6 +1246,37 @@ class _WorkerRuntime:
         except Exception:
             traceback.print_exc()
 
+    def force_checkpoint_actor(self, actor_id: bytes, actor) -> None:
+        """Drain-time forced checkpoint (head's ``checkpoint_now``):
+        serialize ``__ray_save__`` state as raw PARTS — never through
+        this node's store, which is about to die with the drain — and
+        ship them for the head to re-home on its surviving store.
+        ALWAYS replies (descr None without the hook or on a failed
+        save) so the head's deadline-bounded drain never stalls on an
+        actor that cannot checkpoint."""
+        descr = None
+        if actor is not None and hasattr(actor, "__ray_save__"):
+            try:
+                state = actor.__ray_save__()
+                kind = serialization.dumps_adaptive(state, self.max_inline)
+                if kind[0] == "inline":
+                    descr = (protocol.INLINE, kind[1])
+                else:
+                    # bytes() snapshots: the views borrow the actor's
+                    # live buffers, and the send pickles lazily.
+                    descr = (protocol.PARTS, kind[1],
+                             [bytes(v) for v in kind[2]])
+            except Exception:
+                traceback.print_exc()
+        try:
+            # 4th element marks the FORCED reply: the head's drain
+            # rendezvous keys on it — a racing periodic checkpoint must
+            # not release the drain early (nor clobber the re-homed
+            # state; the head guards that side too).
+            self._send(("actor_checkpoint", actor_id, descr, True))
+        except Exception:
+            pass
+
 
 _PULL_MISS = object()
 
@@ -1693,6 +1724,21 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                 daemon=True, name="ray_tpu-lease-adopt").start()
         elif tag == "lease_revoke":
             rt.direct.revoke(msg[1])
+        elif tag == "checkpoint_now":
+            # Drain: force a __ray_save__ of the hosted actor, parts-
+            # shipped so the head re-homes the state on a surviving
+            # store.  Rides the EXECUTION queue, not a fresh thread —
+            # the save must serialize with the running method exactly
+            # like the periodic post-call checkpoint does, or a
+            # mid-method snapshot could tear multi-field state.  Jumps
+            # the queue (ahead of pending calls, after the running one)
+            # unless the actor's create_actor is itself still queued.
+            with tq_cv:
+                if msg[1] in actors:
+                    tasks.appendleft(msg)
+                else:
+                    tasks.append(msg)
+                tq_cv.notify()
         elif tag == "func":
             fns.put(msg[1], msg[2])
         elif tag == "obj":
@@ -1828,6 +1874,12 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
         tag = msg[0]
         if tag == "kill":
             os._exit(0)
+        elif tag == "checkpoint_now":
+            # On the exec thread: the running method (if any) finished
+            # before this popped, so the forced save sees settled state
+            # (max_concurrency>1 actors keep the same exposure their
+            # periodic checkpoints already have).
+            rt.force_checkpoint_actor(msg[1], actors.get(msg[1]))
         elif tag == "create_actor":
             spec = msg[1]
             rt.assigned_resources = spec.get("resources", {})

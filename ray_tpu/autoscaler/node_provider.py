@@ -18,7 +18,11 @@ class NodeProvider:
     """Provider surface the autoscaler programs against: node-type
     catalog + create/terminate/list + per-node type lookup."""
 
-    # name -> {"resources": {...}, "max_workers": int}
+    # name -> {"resources": {...}, "max_workers": int, "spot": bool}.
+    # ``"spot": True`` marks a preemptible slice pool (GCE preemptible /
+    # spot TPU slices): the autoscaler PREFERS spot types while their
+    # observed preemption rate is tolerable and falls back to on-demand
+    # peers past ``spot_fallback_threshold`` preemptions of the type.
     node_types: Dict[str, Dict[str, Any]] = {}
 
     def create_node(self, node_type: str) -> str:
@@ -38,6 +42,10 @@ class NodeProvider:
 
     def max_workers(self, node_type: str) -> int:
         return int(self.node_types[node_type].get("max_workers", 10))
+
+    def is_spot(self, node_type: str) -> bool:
+        spec = self.node_types.get(node_type) or {}
+        return bool(spec.get("spot", False))
 
 
 class FakeSliceProvider(NodeProvider):
@@ -69,8 +77,12 @@ class FakeSliceProvider(NodeProvider):
         return node_id
 
     def terminate_node(self, node_id: str) -> None:
-        self._nodes.pop(node_id, None)
+        # Pop the record only AFTER the removal succeeds: popping first
+        # stranded a live agent the provider no longer tracked whenever
+        # remove_node raised — invisible to non_terminated_nodes, never
+        # terminated again, still burning a slice.
         self._cluster.remove_node(node_id)
+        self._nodes.pop(node_id, None)
 
     def non_terminated_nodes(self) -> List[str]:
         alive = {n["node_id"] for n in self._cluster.rt.list_nodes()

@@ -187,20 +187,25 @@ class ChaosController:
                     pass
         return wid
 
+    def _pick_agent_locked(self, node_id: Optional[str]):
+        """First live agent (optionally scoped to a node hex) — the ONE
+        selection rule for kill_agent and preempt_node, so the two
+        faults always aim at the same target for the same scope."""
+        for agent in self._rt._agents.values():
+            if agent.dead or agent.node is None:
+                continue
+            if node_id is not None \
+                    and agent.node.node_id.hex() != node_id:
+                continue
+            return agent
+        return None
+
     def kill_agent(self, node_id: Optional[str] = None) -> Optional[str]:
         """SIGKILL a node agent process (no graceful shutdown — its
         workers are orphaned exactly as on real node loss).  Returns the
         node id hex, or None."""
-        target = None
         with self._rt.lock:
-            for agent in self._rt._agents.values():
-                if agent.dead or agent.node is None:
-                    continue
-                if node_id is not None \
-                        and agent.node.node_id.hex() != node_id:
-                    continue
-                target = agent
-                break
+            target = self._pick_agent_locked(node_id)
             if target is None:
                 return None
             self._rt.chaos_kills += 1
@@ -219,6 +224,32 @@ class ChaosController:
             pass
         self._rt._on_agent_death(target)
         return nid
+
+    def preempt_node(self, node_id: Optional[str] = None,
+                     notice: bool = True) -> Optional[str]:
+        """Preempt one agent-backed node — the spot/preemptible-slice
+        fault.  With ``notice`` (the provider's warning window) the
+        agent gets SIGUSR1 and self-drains through the head
+        (``preempt_notice`` → drain → clean exit); without, this is the
+        no-warning variant — a straight ``kill_agent`` SIGKILL.
+        Returns the node id hex, or None when nothing matched."""
+        if not notice:
+            return self.kill_agent(node_id)
+        with self._rt.lock:
+            target = self._pick_agent_locked(node_id)
+        if target is None or not target.info.get("pid"):
+            # kill_agent can still take a pid-less agent down (conn
+            # close drives death handling); a NOTICE needs the pid.
+            return None
+        try:
+            os.kill(target.info["pid"], signal.SIGUSR1)
+        except OSError:
+            return None  # pid already gone: no fault was injected
+        # Counted only after the signal landed — unlike kill_agent,
+        # which always drives death handling, a failed notice here is
+        # no event at all and must not burn the exact-count asserts.
+        self._count_kill()
+        return target.node.node_id.hex()
 
     def drop_worker_connection(self,
                                worker_id: Optional[str] = None

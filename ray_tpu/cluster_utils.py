@@ -219,7 +219,9 @@ class Cluster:
             proc.wait(timeout=5)
 
     def shutdown(self):
-        for proc in self._agents.values():
+        # Snapshot: a concurrent remove_node (an autoscaler's off-thread
+        # scale-down concluding mid-teardown) pops from _agents.
+        for proc in list(self._agents.values()):
             try:
                 proc.terminate()
             except Exception:
@@ -240,7 +242,7 @@ class Cluster:
                     os.unlink(snap)
                 except OSError:
                     pass
-        for proc in self._agents.values():
+        for proc in list(self._agents.values()):
             try:
                 proc.wait(timeout=3)
             except Exception:

@@ -150,7 +150,7 @@ class ServeController:
 
             keys = ("cls_or_fn", "init_args", "init_kwargs",
                     "num_replicas", "num_cpus", "num_tpus",
-                    "autoscaling_config")
+                    "autoscaling_config", "ray_actor_options")
             try:
                 return all(
                     _ser.dumps_inline(a.get(k)) == _ser.dumps_inline(
@@ -278,6 +278,12 @@ class ServeController:
                 "max_concurrency": d.get("max_concurrency", 8)}
         if d.get("num_tpus"):
             opts["num_tpus"] = d["num_tpus"]
+        # Extra actor options (elastic pods: a preemption-tolerant
+        # deployment sets {"max_restarts": -1, "max_task_retries": -1}
+        # so replicas ride the PR 9 restart + in-flight replay path
+        # instead of failing requests at the controller's replacement
+        # latency).
+        opts.update(d.get("ray_actor_options") or {})
         remote_cls = ray.remote(ReplicaWrapper)
         actor = remote_cls.options(**opts).remote(
             d["cls_or_fn"], d.get("init_args", ()),
@@ -299,6 +305,7 @@ class ServeController:
                       min(cfg.get("max_replicas", 1), desired))
         cur = len(self._replicas.get(name, []))
         if desired > cur:
+            fire = False
             with self._lock:
                 # Deleted mid-tick: don't repopulate the state the
                 # delete-time purge just cleared (a same-name redeploy
@@ -306,6 +313,9 @@ class ServeController:
                 if name in self._deployments:
                     self._last_scale_up[name] = now
                     self._scale_events.setdefault(name, [0, 0])[0] += 1
+                    fire = True
+            if fire:
+                self._publish_scale_event(name, "up", d)
             return desired
         if desired < cur:
             # Downscale only after a quiet period (reference:
@@ -314,10 +324,40 @@ class ServeController:
                             self._default_downscale_delay_s)
             if now - self._last_scale_up.get(name, 0.0) < delay:
                 return cur
+            fire = False
             with self._lock:
                 if name in self._deployments:
                     self._scale_events.setdefault(name, [0, 0])[1] += 1
+                    fire = True
+            if fire:
+                self._publish_scale_event(name, "down", d)
         return desired
+
+    def _publish_scale_event(self, name: str, direction: str,
+                             d: Dict[str, Any]):
+        """Feed the driver-side node autoscaler (elastic pods): scale
+        events ride the worker->driver pubsub ("serve_scale" topic) and
+        the head wakes any registered listener, so NODE-level scaling
+        reacts to serve-level scaling within one reconcile tick instead
+        of a polling interval.  The payload carries the replica resource
+        shape for observability; the demand itself reaches the
+        autoscaler as the queued replica-creation shapes.  Built and
+        sent OUTSIDE the controller lock (socket IO)."""
+        try:
+            from ray_tpu._private import serialization as _ser
+            from ray_tpu._private.worker_main import get_worker_runtime
+
+            rt = get_worker_runtime()
+            if rt is None:
+                return  # in-process controller (unit tests): no pubsub
+            shape = {"CPU": float(d.get("num_cpus", 1))}
+            if d.get("num_tpus"):
+                shape["TPU"] = float(d["num_tpus"])
+            rt.publish_event("serve_scale", _ser.dumps_inline(
+                {"deployment": name, "direction": direction,
+                 "shape": shape}))
+        except Exception:
+            pass  # observability only: never fail a reconcile over it
 
     def reconcile(self):
         """One control-loop tick: health-check, replace dead, scale to
@@ -876,7 +916,8 @@ class Deployment:
                  num_cpus: float = 1, num_tpus: int = 0,
                  route_prefix: Optional[str] = None,
                  autoscaling_config: Optional[Dict[str, Any]] = None,
-                 max_concurrency: int = 8):
+                 max_concurrency: int = 8,
+                 ray_actor_options: Optional[Dict[str, Any]] = None):
         self._cls_or_fn = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
@@ -891,6 +932,11 @@ class Deployment:
         # this ABOVE max_batch_size: callers park in the batcher, so
         # the thread pool bounds admission, not batch occupancy.
         self.max_concurrency = max_concurrency
+        # Extra @ray.remote options for the replica actors (reference:
+        # serve's ray_actor_options).  Elastic pods: {"max_restarts":
+        # -1, "max_task_retries": -1} makes replicas preemption-
+        # tolerant (restart + in-flight call replay).
+        self.ray_actor_options = ray_actor_options
         self._init_args = ()
         self._init_kwargs = {}
 
@@ -902,7 +948,9 @@ class Deployment:
                        kw.get("route_prefix", self.route_prefix),
                        kw.get("autoscaling_config",
                               self.autoscaling_config),
-                       kw.get("max_concurrency", self.max_concurrency))
+                       kw.get("max_concurrency", self.max_concurrency),
+                       kw.get("ray_actor_options",
+                              self.ray_actor_options))
         d._init_args = self._init_args
         d._init_kwargs = self._init_kwargs
         return d
@@ -918,13 +966,15 @@ def deployment(cls_or_fn=None, *, name: Optional[str] = None,
                num_replicas: int = 1, num_cpus: float = 1,
                num_tpus: int = 0, route_prefix: Optional[str] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
-               max_concurrency: int = 8):
+               max_concurrency: int = 8,
+               ray_actor_options: Optional[Dict[str, Any]] = None):
     """@serve.deployment (reference: serve/api.py deployment)."""
 
     def wrap(target):
         return Deployment(target, name or target.__name__, num_replicas,
                           num_cpus, num_tpus, route_prefix,
-                          autoscaling_config, max_concurrency)
+                          autoscaling_config, max_concurrency,
+                          ray_actor_options)
 
     if cls_or_fn is not None:
         return wrap(cls_or_fn)
@@ -956,6 +1006,7 @@ def run(target: Deployment, *, name: Optional[str] = None
         "num_tpus": target.num_tpus,
         "autoscaling_config": target.autoscaling_config,
         "max_concurrency": target.max_concurrency,
+        "ray_actor_options": target.ray_actor_options,
     }))
     # Route registered at the CONTROLLER so every node's proxy serves it
     # (the driver-thread proxy keeps its local copy too).

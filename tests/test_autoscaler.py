@@ -53,8 +53,15 @@ def test_scale_up_for_infeasible_tpu_tasks_and_scale_down(cluster):
             break
         time.sleep(0.5)
     assert len(gone) == 2, f"idle slices not terminated: {gone}"
-    alive = [n for n in cluster.rt.list_nodes() if n["alive"]]
-    assert len(alive) == 1  # the head
+    # Scale-down drains off-thread (elastic pods): the agents exit at
+    # the drain's conclusion, moments after the report.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        alive = [n for n in cluster.rt.list_nodes() if n["alive"]]
+        if len(alive) == 1:  # the head
+            break
+        time.sleep(0.2)
+    assert len(alive) == 1, alive
 
 
 def test_no_scale_up_when_demand_fits(cluster):
@@ -89,3 +96,151 @@ def test_launch_capped_by_max_workers(cluster):
     report = scaler.update()
     assert report["launched"] == []
     ray.cancel(ref)
+
+
+class _StubRuntime:
+    """Just the surface StandardAutoscaler programs against, with
+    scripted demand/activity — the pending-launch and spot-fallback
+    logic needs no real agents."""
+
+    def __init__(self):
+        self.demand = []
+        self.nodes = [{"node_id": "head", "alive": True, "is_head": True,
+                       "busy": False, "draining": False,
+                       "resources": {"CPU": 1}, "available": {"CPU": 1}}]
+
+    def pending_resource_demand(self):
+        return [dict(s) for s in self.demand]
+
+    def node_activity(self):
+        return [dict(n) for n in self.nodes]
+
+    def add_alive(self, nid, resources):
+        self.nodes.append({"node_id": nid, "alive": True, "is_head": False,
+                           "busy": False, "draining": False,
+                           "resources": dict(resources),
+                           "available": dict(resources)})
+
+    def kill(self, nid):
+        self.nodes = [n for n in self.nodes if n["node_id"] != nid]
+
+
+class _StubProvider:
+    """Provider whose nodes never register on their own: launches stay
+    pending until the test 'boots' them against the stub runtime."""
+
+    def __init__(self, node_types):
+        self.node_types = node_types
+        self._seq = 0
+        self._nodes = {}
+        self.created = []
+
+    def create_node(self, node_type):
+        self._seq += 1
+        nid = f"{node_type}-{self._seq}"
+        self._nodes[nid] = node_type
+        self.created.append(nid)
+        return nid
+
+    def terminate_node(self, node_id):
+        self._nodes.pop(node_id, None)
+
+    def non_terminated_nodes(self):
+        return list(self._nodes)
+
+    def node_type_of(self, node_id):
+        return self._nodes.get(node_id)
+
+    def node_resources(self, t):
+        return dict(self.node_types[t]["resources"])
+
+    def max_workers(self, t):
+        return int(self.node_types[t].get("max_workers", 10))
+
+    def is_spot(self, t):
+        return bool(self.node_types[t].get("spot", False))
+
+
+def test_pending_launch_timeout_reissues_without_double_count():
+    """A launch that never registers is re-issued after
+    _launch_timeout_s — and while pending it counts against caps and
+    capacity, so the same demand is never double-launched meanwhile."""
+    rt = _StubRuntime()
+    provider = _StubProvider({
+        "cpu-2": {"resources": {"CPU": 2}, "max_workers": 1},
+    })
+    scaler = StandardAutoscaler(rt, provider)
+    scaler._launch_timeout_s = 0.3
+    rt.demand = [{"CPU": 2}]
+    report = scaler.update()
+    assert len(report["launched"]) == 1
+    # Pending (not yet registered, not yet timed out): the launch holds
+    # the demand AND the max_workers=1 cap — no second node.
+    assert scaler.update()["launched"] == []
+    assert scaler.update()["launched"] == []
+    assert len(provider.created) == 1
+    time.sleep(0.35)
+    # Timed out: the phantom stops counting and the demand is re-planned
+    # — exactly one replacement launch (the cap still binds).
+    report = scaler.update()
+    assert len(report["launched"]) == 1
+    assert len(provider.created) == 2
+    # The replacement is itself pending now: still no third.
+    assert scaler.update()["launched"] == []
+
+
+def test_spot_preferred_then_fallback_after_preemptions():
+    """Spot node types win ties while healthy; after
+    spot_fallback_threshold observed preemptions of the type the
+    planner launches the on-demand peer instead (per-type
+    accounting)."""
+    rt = _StubRuntime()
+    provider = _StubProvider({
+        # dict order puts spot first anyway — the ranking, not luck, is
+        # what the fallback half of the test pins.
+        "ondemand-2": {"resources": {"CPU": 2}, "max_workers": 8},
+        "spot-2": {"resources": {"CPU": 2}, "max_workers": 8,
+                   "spot": True},
+    })
+    scaler = StandardAutoscaler(rt, provider, spot_fallback_threshold=2)
+    for round_no in range(2):
+        rt.demand = [{"CPU": 2}]
+        (nid,) = scaler.update()["launched"]
+        assert nid.startswith("spot-2"), (round_no, nid)
+        # Register it, then yank it without terminate: a preemption.
+        rt.add_alive(nid, {"CPU": 2})
+        rt.demand = []
+        scaler.update()  # sees it alive; pending clears
+        rt.kill(nid)
+        scaler.update()  # sees it gone: counted + cleaned up
+    assert scaler.stats()["preemptions_by_type"] == {"spot-2": 2}
+    # Threshold reached: same demand now lands on-demand.
+    rt.demand = [{"CPU": 2}]
+    (nid,) = scaler.update()["launched"]
+    assert nid.startswith("ondemand-2"), nid
+
+
+def test_monitor_loop_counts_errors_instead_of_swallowing():
+    """The background loop's failure path: errors are counted and
+    rate-limit-logged (autoscaler_errors), never silently dropped, and
+    the loop survives to keep reconciling."""
+    rt = _StubRuntime()
+
+    class _BrokenProvider(_StubProvider):
+        def non_terminated_nodes(self):
+            raise RuntimeError("cloud API down")
+
+    scaler = StandardAutoscaler(
+        rt, _BrokenProvider({"cpu-2": {"resources": {"CPU": 2}}}),
+        update_interval_s=0.05)
+    rt.demand = [{"CPU": 2}]
+    scaler.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and scaler.stats()["autoscaler_errors"] < 2:
+            time.sleep(0.05)
+        # >= 2: the loop survived its own error and kept ticking.
+        assert scaler.stats()["autoscaler_errors"] >= 2
+    finally:
+        scaler.stop()
