@@ -701,6 +701,109 @@ def recovery_bench():
     return out
 
 
+def degraded_link_bench():
+    """Failure-detection row: a 4-node pull fan-out (producers homed on
+    one node, consumers spread over the other three pulling ~2 MB args
+    across the wire) with the producer node's DATA LINK stalled
+    mid-transfer (env net-chaos rule: its object server parks at chunk
+    2, socket open — the gray failure, nothing EOFs).
+    ``failure_detection`` on vs off: on, every pull's zero-progress
+    deadline trips, the transport retries, then hedges to the
+    head-relay fallback — completion bounded in seconds with the
+    stall/retry/hedge counters lit; off, the pulls block forever and
+    the run only ends at the get timeout (reported timeout-bounded —
+    today's behavior, the row documents exactly what the plane buys).
+    Best-of-3 per mode with raw samples (PR 6/7 convention)."""
+    import tempfile
+
+    import numpy as np
+
+    import ray_tpu as ray
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy as NA,
+    )
+
+    n_objects = 9
+    get_timeout_s = 10.0
+
+    @ray.remote(max_retries=3)
+    def make(i):
+        return np.full(260_000, i, dtype=np.int64)  # ~2 MB
+
+    @ray.remote(max_retries=3)
+    def consume(a):
+        return int(a[0])
+
+    def one_round(fd_on):
+        cfg = {"failure_detection": fd_on}
+        if fd_on:
+            cfg.update({"net_stall_timeout_s": 0.5, "net_retry_count": 1,
+                        "net_retry_backoff_base_ms": 20.0})
+        chaos_dir = tempfile.mkdtemp()
+        # The head merges its own process-wide deadline-core counters
+        # into transfer_stats; rounds share this driver process, so
+        # report per-round DELTAS (the off round must read zero).
+        from ray_tpu._private import protocol as _protocol
+
+        base = _protocol.net_stats()
+        c = Cluster(head_num_cpus=0, _system_config=cfg)
+        try:
+            src = c.add_node(
+                num_cpus=2, external=True,
+                env_overrides={
+                    "RAY_TPU_CHAOS_NET": "agent:chunk_send:stall:2",
+                    "RAY_TPU_CHAOS_DIR": chaos_dir,
+                })
+            sinks = [c.add_node(num_cpus=1, external=True)
+                     for _ in range(3)]
+            s1 = [make.options(scheduling_strategy=NA(
+                node_id=src, soft=True)).remote(i)
+                for i in range(n_objects)]
+            ray.wait(s1, num_returns=len(s1), timeout=60)
+            t0 = time.perf_counter()
+            s2 = [consume.options(scheduling_strategy=NA(
+                node_id=sinks[i % 3], soft=True)).remote(r)
+                for i, r in enumerate(s1)]
+            ok = True
+            try:
+                vals = ray.get(s2, timeout=get_timeout_s)
+                ok = vals == list(range(n_objects))
+            except ray.exceptions.RayTpuError:
+                ok = False  # off: the gray stall only ends at timeout
+            dt = time.perf_counter() - t0
+            stats = c.rt.transfer_stats()
+            return {"wall_s": round(dt, 2), "completed": ok,
+                    "timeout_bounded": not ok,
+                    "stall_timeouts":
+                        stats["stall_timeouts"] - base["stall_timeouts"],
+                    "net_retries":
+                        stats["net_retries"] - base["net_retries"],
+                    "hedged_fetches":
+                        stats["hedged_fetches"] - base["hedged_fetches"],
+                    "suspected_nodes": stats["suspected_nodes"]}
+        finally:
+            c.shutdown()
+
+    def best_of(fd_on, rounds=3):
+        samples = [one_round(fd_on) for _ in range(rounds)]
+        best = min(samples, key=lambda s: (not s["completed"],
+                                           s["wall_s"]))
+        return {**best, "samples": samples}
+
+    out = {"n_objects": n_objects, "get_timeout_s": get_timeout_s,
+           "failure_detection_on": best_of(True),
+           "failure_detection_off": best_of(False)}
+    on, off = out["failure_detection_on"], out["failure_detection_off"]
+    print(f"  [degraded_link] on: {on['wall_s']}s, completed="
+          f"{on['completed']}, stalls={on['stall_timeouts']}, retries="
+          f"{on['net_retries']}, hedged={on['hedged_fetches']}; off: "
+          f"{off['wall_s']}s, completed={off['completed']} "
+          f"(timeout-bounded={off['timeout_bounded']})",
+          file=sys.stderr)
+    return out
+
+
 def elastic_drill_bench():
     """Elastic-pods row: sustained small-task traffic against an
     autoscaled spot slice pool crosses ONE mid-run preemption — drain
@@ -1134,6 +1237,12 @@ def main():
         elastic_drill = {"error": repr(e)}
 
     try:
+        degraded_link = degraded_link_bench()
+    except Exception as e:  # noqa: BLE001 — extra row must not kill core
+        print(f"  [degraded_link] bench failed: {e!r}", file=sys.stderr)
+        degraded_link = {"error": repr(e)}
+
+    try:
         tpu = tpu_bench()
     except Exception as e:  # noqa: BLE001 — device bench must not kill core
         print(f"  [tpu] device bench failed: {e!r}", file=sys.stderr)
@@ -1153,6 +1262,7 @@ def main():
         "recovery": recovery,
         "head_restart_blip": head_restart_blip,
         "elastic_drill": elastic_drill,
+        "degraded_link": degraded_link,
         "tpu": tpu,
     }))
 

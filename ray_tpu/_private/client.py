@@ -191,21 +191,19 @@ class ClientRuntime(_WorkerRuntime):
         use THIS session's authkey — the env fallback the worker-side
         dial reads may hold a stale key from an earlier client session
         in the same process (client_connect's setdefault), which would
-        silently break every lease adoption with an auth error."""
-        from multiprocessing.connection import Client as _Dial
-
-        conn = _Dial(tuple(addr), authkey=self._authkey)
-        protocol.enable_nodelay(conn)
+        silently break every lease adoption with an auth error.
+        Deadline-aware (connect timeout + SO_KEEPALIVE) like every
+        other dial site."""
+        conn = protocol.dial(tuple(addr), authkey=self._authkey)
+        if self._fd_on and self._net_stall_t > 0:
+            # Send half only (see _WorkerRuntime.dial).
+            protocol.set_send_deadline(conn, self._net_stall_t)
         return conn
 
     # -- head failover (client flavor of the worker machinery) -------------
     def _redial(self):
-        from multiprocessing.connection import Client as _Dial
-
-        conn = _Dial(protocol.parse_address(self._address),
-                     authkey=self._authkey)
-        protocol.enable_nodelay(conn)
-        return conn
+        return protocol.dial(protocol.parse_address(self._address),
+                             authkey=self._authkey)
 
     def _re_handshake(self, conn):
         """Clients re-enter through the client_ready handshake (which
@@ -253,15 +251,16 @@ class ClientRuntime(_WorkerRuntime):
 def client_connect(address: str, authkey: bytes,
                    max_inline: int = 1024 * 1024) -> ClientRuntime:
     import time
-    from multiprocessing.connection import Client as _Dial
 
     addr = protocol.parse_address(address)
     conn = None
     err: Optional[BaseException] = None
     for attempt in range(20):
         try:
-            conn = _Dial(addr, authkey=authkey)
-            protocol.enable_nodelay(conn)
+            # Deadline-aware dial: a black-holed head address fails
+            # each attempt in net_connect_timeout_s (the kernel default
+            # is ~2 min — twenty of those is not a retry loop).
+            conn = protocol.dial(addr, authkey=authkey)
             break
         except (ConnectionError, OSError) as e:
             err = e
@@ -332,6 +331,7 @@ def client_connect(address: str, authkey: bytes,
                 if not rt._reconnect_head():
                     return
             else:
+                rt.note_head_recv()  # any head message is liveness
                 handle(m)
 
     threading.Thread(target=reader, daemon=True,
@@ -348,6 +348,9 @@ def client_connect(address: str, authkey: bytes,
                 # a client drives direct pushes too and its counters feed
                 # the same head-side transfer_stats aggregation.
                 rt.flush_xfer_stats()
+                # Failure detection: heartbeat floor + stalled-head
+                # watchdog (client flavor of the worker machinery).
+                rt.heartbeat_and_watchdog()
             except Exception:
                 return
 

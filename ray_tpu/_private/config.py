@@ -204,10 +204,61 @@ class Config:
     # protocheck: head-only -- the pipeline bound is applied at grant time; holders receive it as the grant's slots field
     max_tasks_in_flight_per_worker: int = 10
 
-    # Health-check cadence for worker processes (reference: GCS pull-based
-    # health checks, gcs_health_check_manager.h:39).
-    # protocheck: head-only -- worker health checks run in the head
+    # --- Failure detection (gray failures: alive-but-hung peers;
+    # reference: per-RPC gRPC deadlines + GcsHealthCheckManager with
+    # health_check_initial_delay_ms / timeout / period /
+    # failure_threshold in ray_config_def.h; "Gray Failure: The
+    # Achilles' Heel of Cloud-Scale Systems", HotOS'17 — differential
+    # observation, peer-observed stalls rather than process liveness).
+    # Master switch for the whole plane: deadlines on every wire
+    # operation (connect timeouts + SO_KEEPALIVE on every dial,
+    # zero-progress stall deadlines on transfers with
+    # progress-resets-the-clock semantics, transport retries with
+    # backoff+jitter), worker/agent heartbeat floors, the head's
+    # suspicion state machine (SUSPECT -> probe -> DEAD), and the
+    # direct-channel liveness probes.  Off = the legacy fully-blocking
+    # behavior, byte-identical, with every new counter
+    # (stall_timeouts / net_retries / hedged_fetches / suspected_nodes)
+    # zero. ---
+    failure_detection: bool = True
+    # Zero-progress deadline for one wire operation: a transfer that
+    # moves no bytes for this long is declared stalled (each received/
+    # sent chunk resets the clock, so a slow-but-moving stripe is never
+    # killed while a fully stalled one dies right here).  Also bounds
+    # reply waits on request/reply exchanges and the direct-channel
+    # liveness probe window.
+    net_stall_timeout_s: float = 15.0
+    # Connect timeout for every dial (object-transfer pools, direct
+    # channels, client/agent/worker head dials).  Without it a dial to
+    # a black-holed address blocks for the kernel default (~2 min).
+    net_connect_timeout_s: float = 5.0
+    # Transport-level retry budget for one stalled/broken pull or push:
+    # the broken pooled connection is evicted and the transfer retried
+    # up to this many times before the loss surfaces as a structured
+    # (reconstructable) ObjectLostError(phase="stalled") and the caller
+    # hedges to the relay/reconstruction fallbacks.
+    net_retry_count: int = 2
+    # Base backoff between transport retries; attempt k sleeps
+    # base * 2^k plus up to 50% random jitter.
+    net_retry_backoff_base_ms: float = 50.0
+    # Health-check cadence (reference: GCS pull-based health checks,
+    # gcs_health_check_manager.h:39): the head's suspicion loop ticks at
+    # this period, and it is the worker/agent heartbeat floor — a peer
+    # with no other head traffic sends one ("heartbeat", ...) per
+    # period, so silence is a signal, not an idle link.
     health_check_period_s: float = 5.0
+    # Silence (no message from a node's agent / a worker) longer than
+    # this marks the peer SUSPECT and starts probing it.
+    health_check_timeout_s: float = 15.0
+    # A SUSPECT peer that misses this many consecutive probe windows is
+    # declared DEAD and fed to the existing node/worker-death path —
+    # a stalled node becomes indistinguishable from a killed one within
+    # one suspicion window.
+    health_check_failure_threshold: int = 3
+    # Grace added to a freshly registered peer's first deadline (boot,
+    # env build, and JIT warmup all legitimately delay the first
+    # heartbeat).
+    health_check_initial_delay_s: float = 10.0
 
     # Wait this long for a worker process to start before declaring failure.
     # protocheck: head-only -- spawn timeout enforced by the head

@@ -102,7 +102,8 @@ class _Lease:
     __slots__ = ("worker_id", "addr", "conn", "send_lock", "inflight",
                  "funcs_sent", "dead", "idle_since", "klass",
                  "outbuf", "buf_lock", "node_hex", "slots", "pushed",
-                 "last_renew", "saturated_until", "ttl")
+                 "last_renew", "saturated_until", "ttl", "last_recv",
+                 "ping_sent")
 
     def __init__(self, worker_id: str, addr, klass, node_hex=None,
                  slots=PIPELINE_DEPTH, ttl=0.0):
@@ -134,6 +135,15 @@ class _Lease:
         # self-clock with no added latency floor.
         self.outbuf: List[tuple] = []
         self.buf_lock = threading.Lock()
+        # Channel-liveness state (failure detection): last_recv is
+        # stamped by the reader on EVERY message; the watchdog probes a
+        # channel with in-flight pushes and no traffic for
+        # net_stall_timeout_s (dping — the executor's conn thread
+        # answers even mid-compute) and closes one whose probe went
+        # unanswered for another full window, feeding the existing
+        # conn-EOF rediscovery/reroute path.
+        self.last_recv = time.monotonic()
+        self.ping_sent = 0.0
 
     def send(self, msg):
         with self.send_lock:
@@ -228,6 +238,11 @@ class DirectCaller:
                         if cfg.recovery and cfg.lineage_enabled else None)
         self.reconstructions = 0
         self.reconstruction_failures = 0
+        # Failure detection: the channel-liveness watchdog's stall
+        # window (0 = off, nothing new runs — the legacy behavior where
+        # only a conn EOF discovers a dead executor).
+        self._fd_stall_t = (cfg.net_stall_timeout_s
+                            if cfg.failure_detection else 0.0)
 
     def stats(self) -> Dict[str, int]:
         """Counter snapshot for the xfer_stats delta shipper."""
@@ -786,6 +801,10 @@ class DirectCaller:
             return
         threading.Thread(target=self._lease_reader, args=(lease,),
                          daemon=True).start()
+        if self._fd_stall_t > 0:
+            # Actor channels live outside the lease pools; the linger
+            # loop is also their liveness watchdog.
+            self._ensure_linger_thread()
         self._pump_actor(aid)
 
     def _pump_actor(self, aid: bytes):
@@ -1045,12 +1064,15 @@ class DirectCaller:
             except (EOFError, OSError, TypeError):
                 self._on_lease_dead(lease)
                 return
+            lease.last_recv = time.monotonic()
             if msg[0] == "dresult":
                 self._on_result_batch(lease, [msg[1:]])
             elif msg[0] == "dresult_batch":
                 self._on_result_batch(lease, msg[1])
             elif msg[0] == "dspill":
                 self._on_spillback(lease, msg[1], msg[2])
+            elif msg[0] == "dpong":
+                pass  # the last_recv stamp above IS the liveness signal
 
     def _on_result_batch(self, lease: _Lease, items):
         """Apply a burst of results under ONE lock pass (one notify, one
@@ -1319,10 +1341,35 @@ class DirectCaller:
         out from under it).  The deadline comes from each lease's
         GRANTED ttl — the head's reaper expires against its own config,
         which a config-skewed external client does not share."""
+        stall_t = self._fd_stall_t
+        tick = (min(LEASE_LINGER_S / 2, stall_t / 2) if stall_t > 0
+                else LEASE_LINGER_S / 2)
         while not self._stopped:
-            time.sleep(LEASE_LINGER_S / 2)
+            time.sleep(tick)
             to_return: List[_Lease] = []
             renew: List[str] = []
+            ping: List[_Lease] = []
+            stalled: List[_Lease] = []
+
+            def check_liveness(lease):
+                # Channel-liveness watchdog (failure detection): a
+                # channel with unacked pushes and no traffic for
+                # stall_t gets a dping (answered by the executor's conn
+                # thread even mid-compute — a LONG TASK is not a
+                # stalled link); a probe unanswered for another full
+                # window means the channel, and closing it routes
+                # everything through the existing conn-EOF rediscovery.
+                if (stall_t <= 0 or lease.conn is None or lease.dead
+                        or not lease.inflight):
+                    return
+                if now - lease.last_recv <= stall_t:
+                    return
+                if lease.ping_sent <= lease.last_recv:
+                    lease.ping_sent = now
+                    ping.append(lease)
+                elif now - lease.ping_sent > stall_t:
+                    stalled.append(lease)
+
             now = time.monotonic()
             with self.lock:
                 any_leases = False
@@ -1342,7 +1389,41 @@ class DirectCaller:
                                     > lease.ttl / 3):
                                 lease.last_renew = now
                                 renew.append(lease.worker_id)
+                            check_liveness(lease)
                     pool["leases"] = keep
+                if stall_t > 0:
+                    # Actor channels ride the same watchdog (their
+                    # leases live outside the pools) and keep this
+                    # thread alive while any exist.
+                    for ch in self.actor_channels.values():
+                        lease = ch.get("lease")
+                        if lease is not None:
+                            any_leases = True
+                            check_liveness(lease)
+            if ping:
+                # Outside the lock (socket writes).  SO_SNDTIMEO on
+                # direct-channel conns bounds these; a send failure IS
+                # the stall verdict.
+                for lease in ping:
+                    try:
+                        lease.send(("dping", 0))
+                    except Exception:
+                        stalled.append(lease)
+            if stalled:
+                for lease in stalled:
+                    protocol.note_net_event("stall_timeouts")
+                    try:
+                        # Shutdown, not just close: the reader is by
+                        # precondition parked inside a blocked recv,
+                        # which close() cannot wake on Linux — shutdown
+                        # EOFs it immediately.
+                        protocol.shutdown_conn(lease.conn)
+                        lease.conn.close()
+                    except Exception:
+                        pass
+                    # The parked reader thread's recv now EOFs and
+                    # runs _on_lease_dead: in-flight pushes reroute via
+                    # the head exactly like conn-EOF discovery.
             if renew:
                 try:
                     self.host.head_send(("lease_renew", renew))
@@ -1819,6 +1900,12 @@ class DirectServer:
                 if self._on_task_queued is not None:
                     self._on_task_queued(task)
                 self._enqueue(task, src)
+        elif tag == "dping":
+            # Channel-liveness probe: answer from THIS connection's
+            # thread immediately (never buffered behind result batches
+            # — the probe exists to distinguish a long task from a
+            # stalled link).
+            src.pong(msg[1])
         elif tag == "dfunc":
             self._register_func(msg[1], msg[2])
         elif tag == "dfree":
@@ -1876,6 +1963,15 @@ class _DirectSource:
         try:
             with self.send_lock:
                 protocol.send(self.conn, ("dspill", rid, dict(info)))
+        except Exception:
+            pass  # caller went away; its death handling cleans up
+
+    def pong(self, rid):
+        """Immediate liveness reply (failure detection) — same
+        flow-control exemption as spill()."""
+        try:
+            with self.send_lock:
+                protocol.send(self.conn, ("dpong", rid))
         except Exception:
             pass  # caller went away; its death handling cleans up
 

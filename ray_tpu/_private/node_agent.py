@@ -38,7 +38,7 @@ import subprocess
 import sys
 import threading
 import time
-from multiprocessing.connection import Client, Listener
+from multiprocessing.connection import Listener
 from typing import Dict
 
 from ray_tpu._private import object_transfer, protocol, recovery
@@ -125,6 +125,31 @@ class NodeAgent:
         if os.environ.get("RAY_TPU_PREEMPT_FILE"):
             threading.Thread(target=self._preempt_poller, daemon=True,
                              name="agent-preempt-poll").start()
+        # Heartbeat floor (failure detection): one ("heartbeat", ...)
+        # per health_check_period_s so head-side silence from this node
+        # is a SIGNAL, not an idle link.  The thread starts
+        # unconditionally and gates per-tick on the handshake-resolved
+        # knobs (env wins per node, else the head's agent_ack config).
+        threading.Thread(target=self._heartbeat_loop, daemon=True,
+                         name="agent-heartbeat").start()
+
+    def _heartbeat_loop(self):
+        while not self._stopped and not self._handshake_done.wait(0.2):
+            pass
+        period = float(self._failover_knob("RAY_TPU_HEALTH_CHECK_PERIOD_S",
+                                           "health_check_period_s", 5.0))
+        on = self._failover_knob("RAY_TPU_FAILURE_DETECTION",
+                                 "failure_detection", True)
+        if not on or period <= 0:
+            return
+        while not self._stopped:
+            time.sleep(period)
+            if self.conn is None:
+                continue
+            try:
+                self._send(("heartbeat", self.store_id))
+            except Exception:
+                pass  # head blip: the serve loop owns reconnects
 
     def _preempt_poller(self):
         path = os.environ["RAY_TPU_PREEMPT_FILE"]
@@ -217,8 +242,12 @@ class NodeAgent:
             attempt = 0
             while time.time() < deadline:
                 try:
-                    self.conn = Client(addr, authkey=self.authkey)
-                    protocol.enable_nodelay(self.conn)
+                    # Deadline-aware dial (connect timeout +
+                    # SO_KEEPALIVE): a black-holed head fails this
+                    # attempt in net_connect_timeout_s instead of
+                    # eating the whole grace window in one kernel-
+                    # default connect.
+                    self.conn = protocol.dial(addr, authkey=self.authkey)
                     break
                 except (ConnectionError, OSError):
                     attempt += 1
@@ -226,8 +255,7 @@ class NodeAgent:
         else:
             for attempt in range(40):
                 try:
-                    self.conn = Client(addr, authkey=self.authkey)
-                    protocol.enable_nodelay(self.conn)
+                    self.conn = protocol.dial(addr, authkey=self.authkey)
                     break
                 except (ConnectionError, OSError):
                     time.sleep(0.1 * (attempt + 1))
@@ -249,8 +277,9 @@ class NodeAgent:
             "object_caps": list(object_transfer.CAPS),
             # Agent-plane verbs beyond the original set: the head sends
             # drain_node only to agents declaring it (old agents fall to
-            # the legacy hard teardown).
-            "agent_caps": ["drain_node", "preempt_notice"],
+            # the legacy hard teardown), and probes suspicion suspects
+            # only when they declared hc_probe.
+            "agent_caps": ["drain_node", "preempt_notice", "hc_probe"],
             "pid": os.getpid(),
             "hostname": os.uname().nodename,
             # Failover re-registration: a restarted head re-binds this
@@ -337,6 +366,14 @@ class NodeAgent:
                 # Owner freed an object homed here (the owner-driven
                 # deletion of local_object_manager.h:41).
                 self.store.unlink(msg[1], msg[2])
+            elif tag == "hc_probe":
+                # Suspicion probe: answer from THIS reader thread
+                # immediately — liveness of the LINK and the process,
+                # independent of whatever the node's workers compute.
+                try:
+                    self._send(("heartbeat", self.store_id))
+                except Exception:
+                    pass
             elif tag == "drain_node":
                 # The head drained this node (scale-down order, or the
                 # ack to our own preempt_notice): release any waiting
@@ -520,6 +557,14 @@ def main():
     # Opt-in chaos rules for agent processes (RAY_TPU_CHAOS,
     # "agent:<point>:<n>"); zero cost when unset.
     recovery.maybe_arm_env_chaos("agent")
+    # Net-chaos rules (RAY_TPU_CHAOS_NET, "agent:<point>:<action>:<n>"):
+    # gray failures — stalls/drops/delays at the protocol seam instead
+    # of kills.  Imported lazily so an unarmed agent never loads the
+    # harness.
+    if os.environ.get("RAY_TPU_CHAOS_NET"):
+        from ray_tpu import chaos as chaos_mod
+
+        chaos_mod.maybe_arm_env_net_chaos("agent")
     agent = NodeAgent(
         head_address=os.environ["RAY_TPU_HEAD_ADDRESS"],
         authkey=bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"]),

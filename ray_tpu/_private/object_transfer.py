@@ -39,6 +39,7 @@ put verbs ride the same CAPS advertisement as ``fetch_range``.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 import weakref
@@ -75,6 +76,25 @@ def peer_accepts_puts(caps) -> bool:
     put lifecycle — the capability gate that keeps old-verb-only peers
     on the legacy ``put_parts`` path without ever seeing a new verb."""
     return all(v in caps for v in PUT_CAPS)
+
+
+def _net_stall_timeout() -> float:
+    """This process's zero-progress deadline for wire transfers; 0.0
+    (never arm a deadline — the legacy fully-blocking behavior) with
+    ``failure_detection`` off."""
+    from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+    return (_cfg.net_stall_timeout_s if _cfg.failure_detection else 0.0)
+
+
+def net_params(cfg) -> Tuple[float, float, int, float]:
+    """A Config -> the pool hosts' frozen failure-detection tuple
+    (stall_timeout_s, connect_timeout_s, retry_count, backoff_base_ms);
+    all-zero with the master switch off so nothing new ever runs."""
+    if not cfg.failure_detection:
+        return (0.0, 0.0, 0, 0.0)
+    return (cfg.net_stall_timeout_s, cfg.net_connect_timeout_s,
+            int(cfg.net_retry_count), cfg.net_retry_backoff_base_ms)
 
 # Segment names whose metadata table failed to parse in _true_extent —
 # each is logged once at debug level (bounded; see below).
@@ -132,6 +152,7 @@ def serve_connection(conn, store):
                     total = _true_extent(mv, name)
                     protocol.send(conn, ("ok", total))
                     for off in range(0, total, CHUNK):
+                        protocol.net_point("chunk_send", conn)
                         conn.send_bytes(mv[off:min(off + CHUNK, total)])
                 finally:
                     del mv
@@ -154,6 +175,7 @@ def serve_connection(conn, store):
                     n = max(0, min(length, total - off))
                     protocol.send(conn, ("ok", n, total))
                     for o in range(off, off + n, CHUNK):
+                        protocol.net_point("chunk_send", conn)
                         conn.send_bytes(mv[o:min(o + CHUNK, off + n)])
                 finally:
                     del mv
@@ -307,6 +329,14 @@ class PutRegistry:
         if res is None:
             _drain_discard(conn, length)
             return False
+        # Zero-progress deadline while the stripe payload streams in: a
+        # pusher that stalls mid-stripe errors this connection (the
+        # serve loop's cleanup then aborts the reservation) instead of
+        # wedging a server thread forever.  Cleared before the reply so
+        # the connection's idle wait stays blocking.
+        stall_t = _net_stall_timeout()
+        if stall_t > 0:
+            protocol.set_conn_deadline(conn, stall_t)
         try:
             view = memoryview(res.mm)
             try:
@@ -314,6 +344,11 @@ class PutRegistry:
             finally:
                 del view
         finally:
+            if stall_t > 0:
+                try:
+                    protocol.set_conn_deadline(conn, None)
+                except OSError:
+                    pass
             dispose = False
             with self._lock:
                 res.writers -= 1
@@ -351,16 +386,28 @@ class PutRegistry:
 def _drain_discard(conn, n: int):
     """Consume and discard ``n`` payload bytes from a desynced-put
     stripe so the connection stays at a message boundary for the error
-    reply."""
+    reply.  Deadline-armed: a pusher that stalls mid-drain errors this
+    connection (the serve loop's cleanup closes it) instead of wedging
+    a server thread on a doomed stream."""
     from multiprocessing import BufferTooShort
 
+    stall_t = _net_stall_timeout()
+    if stall_t > 0:
+        protocol.set_conn_deadline(conn, stall_t)
     scratch = bytearray(CHUNK)
     got = 0
-    while got < n:
-        try:
-            got += conn.recv_bytes_into(scratch)
-        except BufferTooShort as e:
-            got += len(e.args[0])
+    try:
+        while got < n:
+            try:
+                got += conn.recv_bytes_into(scratch)  # noqa: RTL403 -- deadline armed above (legacy blocking with the switch off)
+            except BufferTooShort as e:
+                got += len(e.args[0])
+    finally:
+        if stall_t > 0:
+            try:
+                protocol.set_conn_deadline(conn, None)
+            except OSError:
+                pass
 
 
 class _ConnPool:
@@ -377,9 +424,10 @@ class _ConnPool:
     """
 
     __slots__ = ("addr", "authkey", "limit", "idle", "total", "cv",
-                 "closed")
+                 "closed", "connect_timeout")
 
-    def __init__(self, addr: str, authkey: bytes, limit: int):
+    def __init__(self, addr: str, authkey: bytes, limit: int,
+                 connect_timeout: float = 0.0):
         self.addr = addr
         self.authkey = authkey
         self.limit = max(1, limit)
@@ -387,6 +435,8 @@ class _ConnPool:
         self.total = 0
         self.cv = threading.Condition()
         self.closed = False
+        # 0.0 = legacy unbounded dial (failure_detection off).
+        self.connect_timeout = connect_timeout
 
     def acquire(self, timeout: Optional[float] = None):
         """An exclusive connection: a pooled idle one, a fresh dial while
@@ -410,11 +460,14 @@ class _ConnPool:
                     return None
                 self.cv.wait(left)
         try:
-            from multiprocessing.connection import Client
-
-            conn = Client(protocol.parse_address(self.addr),
-                          authkey=self.authkey)
-            protocol.enable_nodelay(conn)
+            # Deadline-aware dial: connect timeout + SO_KEEPALIVE when
+            # the failure-detection plane is on (a black-holed peer
+            # fails the dial in net_connect_timeout_s instead of the
+            # kernel's ~2 min default); the legacy Client() dial with
+            # it off.
+            conn = protocol.dial(protocol.parse_address(self.addr),
+                                 authkey=self.authkey,
+                                 connect_timeout=self.connect_timeout)
             return conn
         except BaseException:
             with self.cv:
@@ -481,11 +534,24 @@ class _PoolHost:
     for the whole stream.
     """
 
-    def __init__(self, authkey: bytes, pool_size: int):
+    def __init__(self, authkey: bytes, pool_size: int,
+                 net_config=None):
         self._authkey = authkey
         self._pool_size = pool_size
         self._pools: Dict[str, _ConnPool] = {}  # store_id -> pool
         self._lock = threading.Lock()  # lock-order: leaf
+        # Failure-detection parameters, frozen at construction
+        # (stall_timeout_s, connect_timeout_s, retry_count,
+        # backoff_base_ms).  Default: this process's GLOBAL_CONFIG; the
+        # head passes its _system_config explicitly.  All zero with the
+        # switch off — no deadline is ever armed, no retry ever runs,
+        # byte-identical legacy blocking transfers.
+        if net_config is None:
+            from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+            net_config = net_params(_cfg)
+        (self._stall_t, self._connect_t, self._net_retries,
+         self._backoff_base_ms) = net_config
 
     def _pool_for(self, store_id: str, addr: str) -> _ConnPool:
         stale = None
@@ -496,10 +562,69 @@ class _PoolHost:
                 stale, pool = pool, None
             if pool is None:
                 pool = self._pools[store_id] = _ConnPool(
-                    addr, self._authkey, self._pool_size)
+                    addr, self._authkey, self._pool_size,
+                    connect_timeout=self._connect_t)
         if stale is not None:
             stale.close()
         return pool
+
+    # ------------------------------------------- deadlines & retries --
+    def _arm(self, conn):
+        """Zero-progress deadline on an exclusively-acquired pooled
+        connection for the duration of one transfer: every syscall gets
+        ``net_stall_timeout_s`` to move bytes (progress resets the
+        clock in the kernel), so a slow-but-moving stripe is never
+        killed while a stalled one dies on time."""
+        if self._stall_t > 0:
+            protocol.set_conn_deadline(conn, self._stall_t)
+
+    def _disarm(self, conn):
+        """Clear the deadline before the connection returns to the pool
+        (idle pooled connections must wait blocking, not time out)."""
+        if self._stall_t > 0:
+            try:
+                protocol.set_conn_deadline(conn, None)
+            except OSError:
+                pass
+
+    def _backoff(self, attempt: int):
+        """Exponential backoff with jitter between transport retries —
+        an in-lockstep retry storm against a recovering peer is its own
+        failure mode."""
+        base = self._backoff_base_ms / 1000.0
+        delay = base * (2 ** (attempt - 1))
+        time.sleep(delay * (1.0 + 0.5 * random.random()))
+
+    def _run_with_net_retries(self, op, describe):
+        """Run one transfer attempt function with the transport-retry
+        policy: a zero-progress stall counts ``stall_timeouts``, evicts
+        only the broken pooled connection (inside ``op``), and retries
+        with backoff+jitter up to ``net_retry_count`` times
+        (``net_retries``); exhaustion raises NetTimeoutError for the
+        caller to wrap into its structured loss error.  Non-stall
+        failures propagate untouched (they were never deadline
+        trips)."""
+        attempt = 0
+        while True:
+            try:
+                return op()
+            except BaseException as e:  # noqa: BLE001 -- stalls filtered, rest re-raised
+                # A helper-stripe stall surfaces wrapped (_StripeError
+                # from the pusher, the raw EAGAIN OSError re-raised from
+                # the error list on the pull side): look one cause deep.
+                if not (protocol.is_stall(e)
+                        or (e.__cause__ is not None
+                            and protocol.is_stall(e.__cause__))):
+                    raise
+                protocol.note_net_event("stall_timeouts")
+                if attempt >= self._net_retries:
+                    raise protocol.NetTimeoutError(
+                        f"{describe} stalled past {self._stall_t}s "
+                        f"({attempt} retr{'y' if attempt == 1 else 'ies'}"
+                        f" exhausted)") from e
+                attempt += 1
+                protocol.note_net_event("net_retries")
+                self._backoff(attempt)
 
     def drop(self, store_id: str):
         with self._lock:
@@ -523,12 +648,14 @@ class ObjectPuller(_PoolHost):
     """
 
     def __init__(self, authkey: bytes, pool_size: Optional[int] = None,
-                 stripe_threshold: Optional[int] = None):
+                 stripe_threshold: Optional[int] = None,
+                 net_config=None):
         from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 
         super().__init__(authkey,
                          pool_size if pool_size is not None
-                         else _cfg.object_pool_size)
+                         else _cfg.object_pool_size,
+                         net_config=net_config)
         self._stripe = (stripe_threshold if stripe_threshold is not None
                         else _cfg.object_stripe_threshold)
 
@@ -543,9 +670,33 @@ class ObjectPuller(_PoolHost):
         advertised verb set: with ``"fetch_range"`` present, a segment at
         least the stripe threshold long arrives as concurrent byte-range
         stripes over several pooled connections.  Returns the filled
-        buffer."""
+        buffer.
+
+        Failure detection: every attempt runs under the zero-progress
+        stall deadline; a stall evicts only the broken pooled connection
+        and the fetch retries (backoff+jitter, ``net_retries``) before
+        surfacing a structured, reconstructable
+        ``ObjectLostError(phase="stalled")`` — the caller then hedges to
+        its existing getparts/relay fallback and ultimately to lineage
+        reconstruction.  A timeout is never a hang."""
+        try:
+            return self._run_with_net_retries(
+                lambda: self._fetch_attempt(store_id, addr, name, sink,
+                                            caps),
+                f"pull of {name} from {store_id}")
+        except protocol.NetTimeoutError as e:
+            from ray_tpu import exceptions as exc
+
+            raise exc.ObjectLostError(
+                f"segment {name} stalled at {store_id}: {e}",
+                object_id=_seg_oid_hex(name), home=store_id,
+                phase="stalled") from e
+
+    def _fetch_attempt(self, store_id: str, addr: str, name: str, sink,
+                       caps: Tuple[str, ...]):
         pool = self._pool_for(store_id, addr)
         conn = pool.acquire()
+        self._arm(conn)
         try:
             if "fetch_range" in caps and self._stripe > 0:
                 buf = self._fetch_striped(pool, conn, store_id, name, sink)
@@ -559,6 +710,7 @@ class ObjectPuller(_PoolHost):
             # pool's other connections are unaffected.
             pool.evict(conn)
             raise
+        self._disarm(conn)
         pool.release(conn)
         return buf
 
@@ -630,12 +782,14 @@ class ObjectPuller(_PoolHost):
                 return
             if c is None:
                 return
+            self._arm(c)
             try:
                 drain(c)
             except BaseException as e:  # noqa: BLE001 — joined below
                 errors.append(e)
                 pool.evict(c)
                 return
+            self._disarm(c)
             pool.release(c)
 
         helpers = [
@@ -693,13 +847,15 @@ class ObjectPusher(_PoolHost):
     """
 
     def __init__(self, authkey: bytes, pool_size: Optional[int] = None,
-                 stripe_threshold: Optional[int] = None):
+                 stripe_threshold: Optional[int] = None,
+                 net_config=None):
         from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 
         super().__init__(authkey,
                          pool_size if pool_size is not None
                          else (_cfg.object_put_pool_size
-                               or _cfg.object_pool_size))
+                               or _cfg.object_pool_size),
+                         net_config=net_config)
         self._stripe = (stripe_threshold if stripe_threshold is not None
                         else _cfg.object_put_stripe_threshold)
 
@@ -711,7 +867,15 @@ class ObjectPusher(_PoolHost):
         or spill path, total the committed byte size — for the caller's
         ``("put_commit", ...)`` control message.  Raises
         PutUnsupportedError (without any wire traffic) when the peer
-        does not advertise the put verbs."""
+        does not advertise the put verbs.
+
+        Failure detection mirrors the pull side: attempts run under the
+        zero-progress stall deadline and retry with backoff+jitter; a
+        retry's fresh ``reserve_put`` is safe because the evicted
+        reserving connection's close already triggered the server-side
+        abort cleanup (the backoff gives it time to land).  Exhaustion
+        raises NetTimeoutError — every caller already treats any push
+        failure as "fall back to the legacy put_parts path"."""
         if not peer_accepts_puts(caps):
             raise PutUnsupportedError(
                 f"peer {store_id} does not speak the put verbs")
@@ -727,8 +891,16 @@ class ObjectPusher(_PoolHost):
         pieces = [(0, memoryview(head)), (_HEADER.size, memoryview(table))]
         pieces += [(off, memoryview(b).cast("B"))
                    for off, b in zip(offsets, buffers)]
+        return self._run_with_net_retries(
+            lambda: self._push_attempt(store_id, addr, oid_bin, pieces,
+                                       total),
+            f"push of {oid_bin.hex()[:12]} to {store_id}")
+
+    def _push_attempt(self, store_id: str, addr: str, oid_bin: bytes,
+                      pieces, total: int):
         pool = self._pool_for(store_id, addr)
         conn = pool.acquire()
+        self._arm(conn)
         name = None
         boundary = True  # primary conn at a message boundary?
         try:
@@ -767,6 +939,7 @@ class ObjectPusher(_PoolHost):
                     pass
             pool.evict(conn)
             raise
+        self._disarm(conn)
         pool.release(conn)
         return kind, ident, size
 
@@ -797,12 +970,14 @@ class ObjectPusher(_PoolHost):
                 return
             if c is None:
                 return
+            self._arm(c)
             try:
                 drain(c)
             except BaseException as e:  # noqa: BLE001 — joined below
                 errors.append(e)
                 pool.evict(c)
                 return
+            self._disarm(c)
             pool.release(c)
 
         helpers = [
@@ -858,6 +1033,7 @@ def _send_piece_range(conn, pieces, off: int, n: int):
         lo = pos - poff
         hi = min(plen, end - poff)
         for o in range(lo, hi, CHUNK):
+            protocol.net_point("chunk_send", conn)
             conn.send_bytes(view[o:min(o + CHUNK, hi)])
         pos = poff + hi
         if pos >= end:
@@ -882,7 +1058,7 @@ def _recv_range(conn, view: memoryview, off: int, n: int):
         # RAY_TPU_CHAOS rule can kill this process deterministically
         # mid-stream — the chaos battery's "die during a striped pull".
         recovery.syncpoint("pull_chunk")
-        got += conn.recv_bytes_into(view, off + got)
+        got += conn.recv_bytes_into(view, off + got)  # noqa: RTL403 -- zero-progress deadline armed by every caller (_PoolHost._arm / recv_parts) before the loop
     if got != n:
         raise OSError(
             f"object stream desync: got {got} bytes for a {n}-byte range")
@@ -903,6 +1079,13 @@ def pull_to_segment(puller: ObjectPuller, store, store_id: str, addr: str,
     state: dict = {}
 
     def sink(total: int):
+        if state.get("reserved"):
+            # A transport retry re-invokes the sink: release the failed
+            # attempt's reservation before making a fresh one.
+            try:
+                store.abort_recv(state["buf"])
+            except Exception:
+                pass
         state["total"] = total
         try:
             buf = store.reserve_recv(name, total)

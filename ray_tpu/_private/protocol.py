@@ -100,6 +100,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from typing import NamedTuple, Optional, Tuple
 
 
@@ -284,6 +285,17 @@ VERBS = {
                     "direct task result (rid, ok, returns, meta)"),
     "dresult_batch": Verb(("worker",), ("worker",), (2, 2),
                           "coalesced direct results"),
+    "dping": Verb(("worker",), ("worker",), (2, 2),
+                  "holder -> executor channel-liveness probe: a lease/"
+                  "actor channel with in-flight pushes and no traffic "
+                  "for net_stall_timeout_s gets one; the executor's "
+                  "connection thread answers dpong even while the task "
+                  "computes, so a long task is never mistaken for a "
+                  "stalled link"),
+    "dpong": Verb(("worker",), ("worker",), (2, 2),
+                  "executor -> holder reply to dping; any channel "
+                  "traffic (this included) resets the holder's stall "
+                  "clock"),
     # -- worker-ownership plane (direct path, via head) --------------------
     "export_obj": Verb(("worker",), ("head",), (2, 2),
                        "delegate worker-owned objects to the head "
@@ -338,6 +350,29 @@ VERBS = {
                        caps="drain_caps"),
     "worker_logs": Verb(("agent",), ("head",), (2, 2),
                         "batched worker stdout/stderr lines"),
+    # -- failure detection (gray failures; reference:
+    # GcsHealthCheckManager + per-RPC gRPC deadlines).  Heartbeats are
+    # the liveness FLOOR under the existing periodic traffic
+    # (xfer_stats, renewals): a peer with nothing else to say still
+    # sends one per health_check_period_s, so head-side silence is a
+    # signal.  All four verbs are sent only while the
+    # ``failure_detection`` switch is on (both sides read the same
+    # plumbed knob, so an off-switch cluster never sees them). --------
+    "heartbeat": Verb(("worker", "client", "agent"), ("head",), (2, 2),
+                      "periodic liveness floor (worker/store id); also "
+                      "the immediate reply to an hc_probe"),
+    "hc_probe": Verb(("head",), ("worker", "agent"), (2, 2),
+                     "suspicion probe: the peer's reader replies "
+                     "heartbeat immediately even while its main thread "
+                     "computes — differential observation of the LINK, "
+                     "not the process"),
+    "hc_ping": Verb(("worker", "client"), ("head",), (2, 2),
+                    "head-connection watchdog probe: a worker/client "
+                    "stuck waiting on a silent head sends one; the "
+                    "head answers with a generic reply — continued "
+                    "silence means the conn is stalled and the "
+                    "watchdog closes it into the reconnect-and-replay "
+                    "path"),
     # -- handshakes / failover ---------------------------------------------
     "client_ready": Verb(("client",), ("head",), (2, 2),
                          "client hello (nonce)"),
@@ -419,12 +454,300 @@ def enable_nodelay(conn) -> None:
         s.close()
 
 
+class NetTimeoutError(OSError):
+    """A wire operation made zero progress for its whole deadline
+    (stalled peer/link) or a dial never completed.  An ``OSError``
+    subclass on purpose: every existing ``except (EOFError, OSError)``
+    discovery site treats a stall exactly like a broken connection —
+    which is the point of the failure-detection plane."""
+
+
+# ------------------------------------------------- net-chaos seam --------
+# The armed hook: callable(point, conn) -> None | "drop" | "dup", or
+# None.  ``ray_tpu.chaos.ChaosNet`` installs it (controller methods in
+# the driver/head, RAY_TPU_CHAOS_NET env rules in spawned workers/
+# agents) to create gray failures AT this seam: delays, full stalls,
+# silent drops (one-way partition), duplicates.  Cost unarmed: one
+# module-global ``is None`` check per send/recv.
+_NET_HOOK = None
+
+
+def set_net_hook(fn) -> None:
+    global _NET_HOOK
+    _NET_HOOK = fn
+
+
+def net_point(point: str, conn) -> Optional[str]:
+    """Named net-chaos point for raw chunk streams (``chunk_send`` in
+    the object servers/pushers); ``send``/``recv`` fire implicitly."""
+    hook = _NET_HOOK
+    if hook is not None:
+        return hook(point, conn)
+    return None
+
+
+# ------------------------------------------------- net counters ----------
+# Process-wide failure-detection counters (the deadline core is the one
+# place every stall/retry/hedge flows through).  Workers and clients
+# ship them to the head in the periodic xfer_stats deltas; the head
+# merges its own process's values in transfer_stats().  All zero with
+# failure_detection off.
+_NET_STATS_LOCK = threading.Lock()  # lock-order: leaf
+_NET_STATS = {"stall_timeouts": 0, "net_retries": 0, "hedged_fetches": 0}
+
+
+def note_net_event(key: str, n: int = 1) -> None:
+    with _NET_STATS_LOCK:
+        _NET_STATS[key] = _NET_STATS.get(key, 0) + n
+
+
+def net_stats() -> dict:
+    with _NET_STATS_LOCK:
+        return dict(_NET_STATS)
+
+
+def _is_timeout_oserror(e: BaseException) -> bool:
+    import errno
+
+    return isinstance(e, OSError) and e.errno in (errno.EAGAIN,
+                                                  errno.EWOULDBLOCK)
+
+
+def is_stall(e: BaseException) -> bool:
+    """Whether an exception is a zero-progress deadline trip — either
+    the typed :class:`NetTimeoutError` or the raw EAGAIN ``OSError`` an
+    armed ``set_conn_deadline`` socket raises from mid-stream
+    ``recv_bytes_into``/``send_bytes`` syscalls."""
+    return isinstance(e, NetTimeoutError) or _is_timeout_oserror(e)
+
+
+def _conn_socket(conn):
+    """A connection's underlying fd duplicated as a ``socket`` object
+    (the caller closes it), or None when the conn has no fd / the fd is
+    not a socket — callers then leave the conn on its legacy
+    fully-blocking behavior."""
+    import socket as _socket
+
+    try:
+        fd = os.dup(conn.fileno())
+    except (OSError, AttributeError):
+        return None
+    try:
+        return _socket.socket(fileno=fd)
+    except OSError:
+        os.close(fd)
+        return None
+
+
+def _set_deadline_opts(conn, timeout_s: Optional[float], opts) -> bool:
+    import socket as _socket
+    import struct as _struct
+
+    s = _conn_socket(conn)
+    if s is None:
+        return False
+    try:
+        t = timeout_s or 0.0
+        tv = _struct.pack("ll", int(t), int((t - int(t)) * 1e6))
+        for opt in opts:
+            s.setsockopt(_socket.SOL_SOCKET, opt, tv)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def set_conn_deadline(conn, timeout_s: Optional[float]) -> bool:
+    """Arm a ZERO-PROGRESS deadline on a connection's underlying socket
+    (``SO_RCVTIMEO`` + ``SO_SNDTIMEO``): every read/write syscall gets
+    ``timeout_s`` to move at least one byte, so progress resets the
+    clock at the kernel and only a fully stalled transfer dies.  A
+    tripped deadline surfaces from the in-flight ``recv_bytes``/
+    ``send_bytes`` as an EAGAIN ``OSError`` — convert at the call site
+    (``recv_deadline`` / the object-transfer range loops) into
+    :class:`NetTimeoutError`.  ``None``/``0`` clears.  Returns False
+    (no-op) when the fd is not a socket — the conn then keeps its
+    legacy fully-blocking behavior."""
+    import socket as _socket
+
+    return _set_deadline_opts(conn, timeout_s,
+                              (_socket.SO_RCVTIMEO, _socket.SO_SNDTIMEO))
+
+
+def set_send_deadline(conn, timeout_s: Optional[float]) -> bool:
+    """Arm only the SEND half of the zero-progress deadline
+    (``SO_SNDTIMEO``).  For long-lived direct channels whose reader
+    legitimately idles between results: sends get bounded (a stalled
+    peer errors the sender into the existing channel-death path) while
+    the blocking reader keeps waiting forever, as it should."""
+    import socket as _socket
+
+    return _set_deadline_opts(conn, timeout_s, (_socket.SO_SNDTIMEO,))
+
+
+def enable_keepalive(conn) -> None:
+    """Arm TCP keepalive on a dialed connection so a peer that vanishes
+    without a FIN (powered-off VM, dropped route) eventually errors out
+    of even the legacy blocking paths (reference: gRPC channel
+    keepalive).  No-op for AF_UNIX."""
+    import socket as _socket
+
+    s = _conn_socket(conn)
+    if s is None:
+        return
+    try:
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_KEEPALIVE, 1)
+        for opt, val in (("TCP_KEEPIDLE", 30), ("TCP_KEEPINTVL", 10),
+                         ("TCP_KEEPCNT", 6)):
+            if hasattr(_socket, opt):
+                s.setsockopt(_socket.IPPROTO_TCP,
+                             getattr(_socket, opt), val)
+    except OSError:
+        pass  # AF_UNIX
+    finally:
+        s.close()
+
+
+def shutdown_conn(conn) -> None:
+    """``shutdown(SHUT_RDWR)`` a connection's underlying socket, then
+    nothing else — the caller still owns the close.  THE way to take a
+    connection away from a thread parked inside a blocking ``recv``:
+    on Linux, ``close()`` alone does NOT wake a thread already blocked
+    in ``read()`` on the fd (it only drops this process's reference),
+    while shutdown delivers an immediate EOF to it.  Every watchdog
+    that retires a stalled connection (the direct-channel liveness
+    probe, the worker's stalled-head watchdog) must go through this or
+    its parked reader never runs the death/reconnect path."""
+    s = _conn_socket(conn)
+    if s is None:
+        return
+    import socket as _socket
+
+    try:
+        s.shutdown(_socket.SHUT_RDWR)
+    except OSError:
+        pass  # already disconnected
+    finally:
+        s.close()
+
+
+def dial(address, authkey: Optional[bytes] = None,
+         connect_timeout: Optional[float] = None):
+    """Deadline-aware ``multiprocessing.connection.Client``: bounded
+    connect (a dial to a black-holed address fails in
+    ``net_connect_timeout_s``, not the kernel's ~2 min default),
+    ``SO_KEEPALIVE`` armed, Nagle off, and the auth handshake bounded
+    by the same window (an accepted-but-stalled listener cannot hang
+    the dialer).  ``connect_timeout=None`` reads the config knob; with
+    ``failure_detection`` off this is byte-identical to the legacy
+    ``Client()`` dial."""
+    from multiprocessing.connection import Client
+
+    if isinstance(address, str) and address.startswith("tcp://"):
+        address = parse_address(address)
+    if connect_timeout is None:
+        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+        connect_timeout = (_cfg.net_connect_timeout_s
+                           if _cfg.failure_detection else 0.0)
+    if not connect_timeout or connect_timeout <= 0:
+        conn = Client(tuple(address) if isinstance(address, (tuple, list))
+                      else address, authkey=authkey)
+        enable_nodelay(conn)
+        return conn
+
+    import socket as _socket
+    from multiprocessing.connection import (Connection, answer_challenge,
+                                            deliver_challenge)
+
+    try:
+        if isinstance(address, (tuple, list)):
+            s = _socket.create_connection(tuple(address),
+                                          timeout=connect_timeout)
+            try:
+                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        else:
+            s = _socket.socket(_socket.AF_UNIX)
+            s.settimeout(connect_timeout)
+            s.connect(address)
+        try:
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_KEEPALIVE, 1)
+        except OSError:
+            pass
+        s.settimeout(None)  # back to blocking; deadlines are per-op
+    except (_socket.timeout, TimeoutError) as e:
+        raise NetTimeoutError(
+            f"dial to {address!r} timed out after "
+            f"{connect_timeout}s") from e
+    conn = Connection(s.detach())
+    if authkey is not None:
+        # Bound the handshake too: the listener accepted but its
+        # process may be hung.
+        set_conn_deadline(conn, connect_timeout)
+        try:
+            answer_challenge(conn, authkey)
+            deliver_challenge(conn, authkey)
+        except OSError as e:
+            conn.close()
+            if _is_timeout_oserror(e):
+                raise NetTimeoutError(
+                    f"auth handshake with {address!r} stalled past "
+                    f"{connect_timeout}s") from e
+            raise
+        except EOFError:
+            conn.close()
+            raise
+        finally:
+            try:
+                set_conn_deadline(conn, None)
+            except OSError:
+                pass
+    enable_keepalive(conn)
+    return conn
+
+
 def send(conn, msg: tuple):
+    hook = _NET_HOOK
+    if hook is not None:
+        verdict = hook("send", conn)
+        if verdict == "drop":
+            return
+        if verdict == "dup":
+            conn.send_bytes(pickle.dumps(msg, protocol=5))
     conn.send_bytes(pickle.dumps(msg, protocol=5))
 
 
 def recv(conn) -> tuple:
-    return pickle.loads(conn.recv_bytes())
+    hook = _NET_HOOK
+    if hook is not None:
+        hook("recv", conn)
+    return pickle.loads(conn.recv_bytes())  # noqa: RTL403 -- the deadline core's own primitive; deadlines arm via set_conn_deadline/recv_deadline
+
+
+def recv_deadline(conn, timeout_s: Optional[float]) -> tuple:
+    """``recv`` bounded by a zero-progress deadline: the peer gets
+    ``timeout_s`` per syscall to move bytes (progress resets the
+    clock); full silence raises :class:`NetTimeoutError`.  ``None``/
+    ``<=0`` falls back to the plain blocking recv (the legacy path)."""
+    if not timeout_s or timeout_s <= 0:
+        return recv(conn)
+    armed = set_conn_deadline(conn, timeout_s)
+    try:
+        return recv(conn)
+    except OSError as e:
+        if armed and _is_timeout_oserror(e):
+            raise NetTimeoutError(
+                f"recv stalled past {timeout_s}s") from e
+        raise
+    finally:
+        if armed:
+            try:
+                set_conn_deadline(conn, None)
+            except OSError:
+                pass
 
 
 # Batch-envelope tag (plus the pre-envelope spelling still emitted by old

@@ -188,7 +188,8 @@ class WorkerHandle:
         "lease_key", "lease_req", "lease_pg", "blocked",
         "pending_force_kill", "direct_addr", "client_lease",
         "oom_killed", "last_dispatch_ts", "lease_expiry",
-        "lease_offer_ts", "lease_caps",
+        "lease_offer_ts", "lease_caps", "last_seen", "hc_suspect",
+        "hc_misses", "hc_probe_ts",
     )
 
     def __init__(self, worker_id, conn, proc, node, env_key, tpu_chips):
@@ -244,6 +245,13 @@ class WorkerHandle:
         # workers this head spawned (same build, env-matched); an
         # external client earns it by sending a v1 lease_req.
         self.lease_caps = False
+        # Failure detection: last message seen from this worker
+        # (stamped by the reader wrapper, re-seeded with the initial
+        # delay at attach) + the suspicion machine's state.
+        self.last_seen = time.monotonic()
+        self.hc_suspect = False
+        self.hc_misses = 0
+        self.hc_probe_ts = 0.0
 
     def send(self, msg):
         with self.send_lock:
@@ -303,6 +311,12 @@ class AgentHandle:
         self._rid = 0
         self._pending: Dict[int, Future] = {}
         self._pending_lock = threading.Lock()
+        # Failure detection: last message from this agent (heartbeats
+        # are the floor) + suspicion state (SUSPECT -> probe -> DEAD).
+        self.last_seen = time.monotonic()
+        self.hc_suspect = False
+        self.hc_misses = 0
+        self.hc_probe_ts = 0.0
 
     def send(self, msg):
         with self.send_lock:
@@ -311,13 +325,25 @@ class AgentHandle:
     def request_segment(self, name: str, timeout: float = 30.0):
         """Blocking HEAD-RELAYED read of a remote segment's serialized
         parts — the fallback when a direct object-server pull is not
-        possible.  Must be called WITHOUT the runtime lock held."""
+        possible.  Must be called WITHOUT the runtime lock held.  The
+        deadline makes a stalled agent a structured, reconstructable
+        loss (phase="stalled") instead of a 30s-or-forever hang."""
         with self._pending_lock:
             self._rid += 1
             rid = self._rid
             fut = self._pending[rid] = Future()
         self.send(("read_segment", rid, name))
-        ok, payload = fut.result(timeout=timeout)
+        try:
+            ok, payload = fut.result(timeout=timeout)
+        except Exception as e:  # concurrent.futures.TimeoutError
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            protocol.note_net_event("stall_timeouts")
+            raise exc.ObjectLostError(
+                f"relay read of {name} from {self.store_id} stalled "
+                f"past {timeout}s",
+                object_id=_seg_oid_hex(name), home=self.store_id,
+                phase="stalled") from e
         if not ok:
             raise exc.ObjectLostError(object_id=_seg_oid_hex(name),
                                       home=self.store_id, phase="relay")
@@ -487,7 +513,11 @@ class Runtime:
         # and counts its uses (tests assert it stays cold).
         self._puller = object_transfer.ObjectPuller(  # authkey set below
             b"", pool_size=config.object_pool_size,
-            stripe_threshold=config.object_stripe_threshold)
+            stripe_threshold=config.object_stripe_threshold,
+            # Explicit net params: the head's _system_config overrides
+            # must govern its own pulls, not the env-built
+            # GLOBAL_CONFIG.
+            net_config=object_transfer.net_params(config))
         self.relayed_segments = 0   # head-relayed agent reads (fallback)
         self.brokered_parts = 0     # worker getparts served via the head
         # Write-direction counters (all zero while direct_puts is off —
@@ -575,6 +605,18 @@ class Runtime:
         self.drains_completed = 0
         self.drain_timeouts = 0
         self.objects_migrated = 0
+        # Failure-detection counters (all zero while failure_detection
+        # is off — pinned by tests): suspected_nodes = peers (node
+        # agents AND workers) the suspicion machine marked SUSPECT
+        # after health_check_timeout_s of silence; stall_timeouts /
+        # net_retries / hedged_fetches aggregate the deadline core's
+        # process-wide counters from every worker/client (xfer_stats
+        # deltas) plus this head process's own (merged at
+        # transfer_stats time).
+        self.suspected_nodes = 0
+        self.stall_timeouts = 0
+        self.net_retries = 0
+        self.hedged_fetches = 0
         # Drain rendezvous: aid -> Event set when the forced
         # ("checkpoint_now", aid) round-trips as an actor_checkpoint;
         # node_id -> [done_event, outcome, deadline_abs] for that
@@ -693,6 +735,14 @@ class Runtime:
         self._reaper = threading.Thread(
             target=self._reap_loop, daemon=True, name="ray_tpu-reaper")
         self._reaper.start()
+        if config.failure_detection:
+            # Heartbeat suspicion (reference: GcsHealthCheckManager):
+            # silence -> SUSPECT -> probe -> DEAD, feeding the existing
+            # node/worker-death paths — a stalled peer becomes
+            # indistinguishable from a killed one within one suspicion
+            # window.  Off-switch = no thread, no probes, counter zero.
+            threading.Thread(target=self._suspicion_loop, daemon=True,
+                             name="ray_tpu-suspicion").start()
         if config.memory_monitor_threshold > 0:
             threading.Thread(target=self._memory_monitor_loop,
                              daemon=True, name="ray_tpu-memmon").start()
@@ -1815,13 +1865,22 @@ class Runtime:
                     self._puller, self.shm, home, addr, descr[1],
                     caps=caps)
                 return seg.raw_parts()
-            except exc.ObjectLostError:
-                raise
+            except exc.ObjectLostError as e:
+                if getattr(e, "phase", None) != "stalled":
+                    raise
+                # Stalled direct pull (deadline + retries exhausted):
+                # HEDGE to the relay instead of propagating — the
+                # agent's control link may still move even when its
+                # object server does not.
+                protocol.note_net_event("hedged_fetches")
             except Exception:
                 pass  # conn trouble: fall back to the head relay
         with self.lock:
             self.relayed_segments += 1
-        return agent.request_segment(descr[1])
+        cfg = self.config
+        relay_timeout = (max(2.0 * cfg.net_stall_timeout_s, 5.0)
+                         if cfg.failure_detection else 30.0)
+        return agent.request_segment(descr[1], timeout=relay_timeout)
 
     def get_objects(self, refs, timeout=None):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -2597,6 +2656,29 @@ class Runtime:
                 str(self.config.head_reconnect_grace_s),
             "RAY_TPU_HEAD_REREGISTER_TIMEOUT_S":
                 str(self.config.head_reregister_timeout_s),
+            # Failure-detection knobs (gray failures): workers read the
+            # master switch, the wire deadlines/retries, and the
+            # heartbeat period; the head-side suspicion knobs ride too
+            # so a worker-spawned subprocess that becomes a driver sees
+            # one coherent config.
+            "RAY_TPU_FAILURE_DETECTION":
+                "1" if self.config.failure_detection else "0",
+            "RAY_TPU_NET_STALL_TIMEOUT_S":
+                str(self.config.net_stall_timeout_s),
+            "RAY_TPU_NET_CONNECT_TIMEOUT_S":
+                str(self.config.net_connect_timeout_s),
+            "RAY_TPU_NET_RETRY_COUNT":
+                str(self.config.net_retry_count),
+            "RAY_TPU_NET_RETRY_BACKOFF_BASE_MS":
+                str(self.config.net_retry_backoff_base_ms),
+            "RAY_TPU_HEALTH_CHECK_PERIOD_S":
+                str(self.config.health_check_period_s),
+            "RAY_TPU_HEALTH_CHECK_TIMEOUT_S":
+                str(self.config.health_check_timeout_s),
+            "RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD":
+                str(self.config.health_check_failure_threshold),
+            "RAY_TPU_HEALTH_CHECK_INITIAL_DELAY_S":
+                str(self.config.health_check_initial_delay_s),
         }
 
     def _spawn_worker(self, node: NodeState, env_key: str,
@@ -2807,6 +2889,10 @@ class Runtime:
                 w.lease_caps = True
                 w.attach(conn)
                 w.ready.set()
+                # First suspicion deadline gets the initial-delay slack
+                # (boot/env/JIT warmup legitimately delay heartbeats).
+                w.last_seen = (time.monotonic()
+                               + self.config.health_check_initial_delay_s)
                 self._conn_to_worker[conn] = w
                 self._workers_by_hex[worker_id_hex] = w
             # One reader thread per connection (replaces the old select
@@ -2821,6 +2907,8 @@ class Runtime:
         NodeManager::RegisterGcs, gcs_node_manager.h:41 HandleRegisterNode).
         """
         agent = AgentHandle(conn, info["store_id"], info["shm_dir"], info)
+        agent.last_seen = (time.monotonic()
+                           + self.config.health_check_initial_delay_s)
         resources = dict(info.get("resources") or {"CPU": 1.0})
         resources.setdefault("memory", float(2 ** 33))
         with self.lock:
@@ -2880,7 +2968,14 @@ class Runtime:
                   "drain_caps": (["preempt_notice", "drain_node"]
                                  if self.config.elastic_drain else []),
                   "elastic_drain": self.config.elastic_drain,
-                  "drain_deadline_s": self.config.drain_deadline_s}))
+                  "drain_deadline_s": self.config.drain_deadline_s,
+                  # Failure detection: the agent mirrors the master
+                  # switch and heartbeat cadence (its env wins per
+                  # node) so an off-switch cluster sends zero
+                  # heartbeats and a tuned period applies everywhere.
+                  "failure_detection": self.config.failure_detection,
+                  "health_check_period_s":
+                      self.config.health_check_period_s}))
         threading.Thread(target=self._agent_reader, args=(conn, agent),
                          daemon=True, name="ray_tpu-rx-agent").start()
         with self.lock:
@@ -4303,6 +4398,11 @@ class Runtime:
             except (EOFError, OSError, TypeError):
                 self._on_agent_death(agent)
                 return
+            # Failure detection: ANY agent message is liveness (the
+            # heartbeat floor guarantees at least one per period).
+            # Benign unlocked write — the suspicion loop reads it
+            # monotonically.
+            agent.last_seen = time.monotonic()
             try:
                 self._handle_agent_msg(agent, msg)
             except Exception:
@@ -4310,7 +4410,9 @@ class Runtime:
                 traceback.print_exc()
 
     def _handle_agent_msg(self, agent: AgentHandle, msg: tuple):
-        if msg[0] == "segment":
+        if msg[0] == "heartbeat":
+            pass  # liveness stamped by the reader wrapper
+        elif msg[0] == "segment":
             agent.deliver(msg[1], msg[2], msg[3])
         elif msg[0] == "oom_pressure":
             # The node's agent sampled its own memory over threshold;
@@ -4383,6 +4485,9 @@ class Runtime:
                     import traceback
                     traceback.print_exc()
             return
+        # Failure detection: any worker message is liveness (benign
+        # unlocked write; the suspicion loop reads it monotonically).
+        worker.last_seen = time.monotonic()
         t0 = time.perf_counter()
         try:
             return self._handle_worker_msg_inner(worker, msg)
@@ -4402,6 +4507,14 @@ class Runtime:
         tag = msg[0]
         if tag == "ready":
             worker.ready.set()
+        elif tag == "heartbeat":
+            pass  # liveness stamped by the handler wrapper
+        elif tag == "hc_ping":
+            # Stalled-head watchdog probe from a worker/client stuck
+            # waiting on us: any reply resets its clock.  Rides the
+            # conflation sender — proving the whole send path moves is
+            # the point.
+            self._queue_send(worker, ("reply", msg[1], "pong"))
         elif tag == "spans":
             # Task execution spans from a worker (task events; feeds
             # `ray_tpu.timeline()` — scripts.py:1840 `ray timeline`).
@@ -4446,6 +4559,11 @@ class Runtime:
                 self.reconstructions += d.get("reconstructions", 0)
                 self.reconstruction_failures += d.get(
                     "reconstruction_failures", 0)
+                # Failure-detection deltas from the worker's deadline
+                # core (zero with the switch off).
+                self.stall_timeouts += d.get("stall_timeouts", 0)
+                self.net_retries += d.get("net_retries", 0)
+                self.hedged_fetches += d.get("hedged_fetches", 0)
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
         elif tag == "result_batch":
@@ -5665,6 +5783,125 @@ class Runtime:
             for wid, lines in tail_worker_logs(log_dir, offsets, partial):
                 self._record_worker_lines(wid, lines)
 
+    # -------------------------------------------------------- suspicion --
+    def _suspicion_loop(self):
+        """Head-side gray-failure detector (reference:
+        gcs_health_check_manager.h — initial delay / timeout / period /
+        failure threshold; HotOS'17 gray failure: DIFFERENTIAL
+        observation, this peer's link to us, not its process table).
+
+        Every live agent and worker is expected to message us at least
+        once per ``health_check_period_s`` (the heartbeat floor rides
+        under their existing periodic traffic).  Silence past
+        ``health_check_timeout_s`` marks the peer SUSPECT (counted) and
+        starts probing (``hc_probe`` — answered by the peer's reader
+        thread even while it computes); ``health_check_failure_threshold``
+        unanswered probes declare it DEAD and feed the EXISTING death
+        path — lease revocation, lineage reconstruction, drain
+        bookkeeping — exactly as a clean kill would."""
+        cfg = self.config
+        timeout = cfg.health_check_timeout_s
+        period = cfg.health_check_period_s
+        threshold = max(1, cfg.health_check_failure_threshold)
+        tick = max(0.1, min(period, timeout / 2.0 or period) / 2.0)
+        # Initial grace: a freshly-booted cluster's peers get extra slack
+        # before their first deadline (boot + env build + JIT warmup).
+        initial = cfg.health_check_initial_delay_s
+        time.sleep(min(initial, 2.0) if initial > 0 else tick)
+        while not self._stopped:
+            time.sleep(tick)
+            now = time.monotonic()
+            probes = []   # (send_fn, peer) pairs, fired outside the lock
+            dead_agents = []
+            dead_workers = []
+            with self.lock:
+                for agent in list(self._agents.values()):
+                    if agent.dead or agent.node is None:
+                        continue
+                    if "hc_probe" not in tuple(
+                            agent.info.get("agent_caps") or ()):
+                        continue  # old agent: never probed (PR-3 rule)
+                    self._suspect_step_locked(agent, now, timeout,
+                                              period, threshold,
+                                              probes, dead_agents)
+                for node in self.nodes.values():
+                    for w in node.all_workers.values():
+                        if (w.dead or w.conn is None
+                                or not w.ready.is_set()
+                                or w.env_key == "client"):
+                            continue
+                        self._suspect_step_locked(w, now, timeout,
+                                                  period, threshold,
+                                                  probes, dead_workers)
+            for peer in probes:
+                # Try-lock, not send(): a dispatcher blocked mid-send
+                # to this very peer (wedged reader, full buffer) holds
+                # send_lock — the probe must not wedge the suspicion
+                # thread with it.  The miss was already counted; an
+                # unsendable probe is just a confirmed miss.
+                if not peer.send_lock.acquire(timeout=0.5):
+                    continue
+                try:
+                    protocol.send(peer.conn, ("hc_probe", 0))
+                except Exception:
+                    pass  # a failed probe send is itself a miss
+                finally:
+                    peer.send_lock.release()
+            for agent in dead_agents:
+                print(f"[ray_tpu] failure detection: node "
+                      f"{agent.node.node_id.hex()[:12]} declared DEAD "
+                      f"after {threshold} missed probes "
+                      f"(silent {now - agent.last_seen:.1f}s)",
+                      file=sys.stderr)
+                try:
+                    # Shutdown frees a reader parked inside a stalled
+                    # recv (close alone cannot wake it); it exits via
+                    # the idempotent death path.
+                    protocol.shutdown_conn(agent.conn)
+                    agent.conn.close()
+                except Exception:
+                    pass
+                # Drive death handling NOW, like chaos.kill_agent —
+                # don't depend on the reader waking at all.
+                self._on_agent_death(agent)
+            for w in dead_workers:
+                print(f"[ray_tpu] failure detection: worker "
+                      f"{w.worker_id.hex()[:12]} declared DEAD after "
+                      f"{threshold} missed probes",
+                      file=sys.stderr)
+                conn = w.conn
+                self._on_worker_death(w)
+                if conn is not None:
+                    try:
+                        protocol.shutdown_conn(conn)
+                        conn.close()
+                    except Exception:
+                        pass
+
+    def _suspect_step_locked(self, peer, now, timeout, period, threshold,
+                             probes, dead):
+        """One suspicion-machine step for one peer (WorkerHandle or
+        AgentHandle — both carry last_seen/hc_* state).  Appends to
+        ``probes``/``dead`` for the caller to act on OUTSIDE the lock."""
+        silence = now - peer.last_seen
+        if silence <= timeout:
+            if peer.hc_suspect:
+                peer.hc_suspect = False  # spoke again: fully absolved
+            peer.hc_misses = 0
+            return
+        if not peer.hc_suspect:
+            peer.hc_suspect = True
+            peer.hc_misses = 0
+            peer.hc_probe_ts = 0.0
+            self.suspected_nodes += 1
+        if now - peer.hc_probe_ts >= period:
+            peer.hc_probe_ts = now
+            peer.hc_misses += 1
+            if peer.hc_misses > threshold:
+                dead.append(peer)
+            else:
+                probes.append(peer)
+
     # ------------------------------------------------------------- reaper --
     def _reap_loop(self):
         while not self._stopped:
@@ -6129,8 +6366,19 @@ class Runtime:
         """Data-plane + locality counters in one snapshot: the scheduler's
         locality accounting plus the aggregated worker-side prefetch/
         dedup deltas, next to the head's own relay fallbacks."""
+        # The head process's OWN deadline-core counters (its puller /
+        # relay stalls) merge with the worker/client deltas aggregated
+        # below — one cluster-wide number per counter.
+        head_net = protocol.net_stats()
         with self.lock:
             return {
+                "suspected_nodes": self.suspected_nodes,
+                "stall_timeouts":
+                    self.stall_timeouts + head_net["stall_timeouts"],
+                "net_retries":
+                    self.net_retries + head_net["net_retries"],
+                "hedged_fetches":
+                    self.hedged_fetches + head_net["hedged_fetches"],
                 "locality_hits": self.locality_hits,
                 "locality_misses": self.locality_misses,
                 "locality_bytes_saved": self.locality_bytes_saved,

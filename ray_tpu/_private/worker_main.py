@@ -183,6 +183,22 @@ class _WorkerRuntime:
         self.snapshot_tasks = lambda: []
         self.snapshot_actors = lambda: []
         self._executing_tasks: list = []  # (task, is_direct) pairs
+        # --- Failure detection (gray failures): head-connection
+        # watchdog state.  _last_head_recv/_last_head_send feed the
+        # heartbeat floor (quiet link -> one heartbeat per
+        # health_check_period_s) and the stalled-head detector: a
+        # pending request older than net_stall_timeout_s with total
+        # head silence first sends an hc_ping probe; continued silence
+        # CLOSES the conn, turning the gray stall into the clean EOF
+        # the PR-10 reconnect-and-replay machinery already survives.
+        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+        self._fd_on = _cfg.failure_detection
+        self._hc_period = _cfg.health_check_period_s
+        self._net_stall_t = _cfg.net_stall_timeout_s
+        self._last_head_recv = time.monotonic()
+        self._last_head_send = time.monotonic()
+        self._hc_probe_sent = 0.0
 
     # -- peer messaging (ring collectives etc.) ----------------------------
     def register_peer_handler(self, channel: str, fn):
@@ -223,6 +239,7 @@ class _WorkerRuntime:
             return
         try:
             protocol.send_batch(self.conn, msgs)
+            self._last_head_send = time.monotonic()
         except Exception:
             if not self._failover or self._shutting_down:
                 raise
@@ -230,12 +247,17 @@ class _WorkerRuntime:
             self._head_outbox.extend(msgs)
 
     def dial(self, addr):
-        from multiprocessing.connection import Client
-
-        conn = Client(tuple(addr),
-                      authkey=bytes.fromhex(
-                          os.environ.get("RAY_TPU_AUTHKEY", "")))
-        protocol.enable_nodelay(conn)
+        # Deadline-aware dial (connect timeout + SO_KEEPALIVE when
+        # failure detection is on): direct channels to black-holed
+        # peers fail in net_connect_timeout_s, not the kernel default.
+        conn = protocol.dial(tuple(addr),
+                             authkey=bytes.fromhex(
+                                 os.environ.get("RAY_TPU_AUTHKEY", "")))
+        if self._fd_on and self._net_stall_t > 0:
+            # Send half only: pushes to a stalled executor error the
+            # sender into the channel-death reroute; the reader stays
+            # fully blocking (an idle channel is not a stalled one).
+            protocol.set_send_deadline(conn, self._net_stall_t)
         return conn
 
     def get_payload(self, func_id: str) -> Optional[bytes]:
@@ -375,6 +397,10 @@ class _WorkerRuntime:
         # aggregates leased_submits/spillbacks next to its own
         # lease_grants/head_brokered_submits).
         cur.update(self.direct.stats())
+        # Failure-detection counters (stall_timeouts / net_retries /
+        # hedged_fetches) — process-wide in the protocol deadline core,
+        # aggregated by the head exactly like the rest.
+        cur.update(protocol.net_stats())
         with self._xfer_lock:
             delta = {}
             for k, v in cur.items():
@@ -446,8 +472,11 @@ class _WorkerRuntime:
         with self.pending_lock:
             # The built message is retained alongside the slot: a head
             # restart replays every still-pending request verbatim to
-            # the new incarnation (park-and-replay).
-            self.pending[req_id] = (slot, msg)
+            # the new incarnation (park-and-replay).  The timestamp
+            # feeds the head-connection watchdog (a request aging past
+            # net_stall_timeout_s under total head silence is the
+            # gray-failure signal).
+            self.pending[req_id] = (slot, msg, time.monotonic())
         self._send(msg)
         reply = slot.get()
         with self.pending_lock:
@@ -460,16 +489,73 @@ class _WorkerRuntime:
         if ent is not None:
             ent[0].put(payload)
 
+    # -- failure detection: heartbeat floor + head-conn watchdog -----------
+    def note_head_recv(self):
+        """Reader-thread hook: any head message is liveness."""
+        self._last_head_recv = time.monotonic()
+        self._hc_probe_sent = 0.0
+
+    def heartbeat_and_watchdog(self):
+        """Periodic-flusher hook (failure detection; no-op with the
+        switch off).  Two jobs: (a) the heartbeat FLOOR — a link with
+        no other outgoing traffic for health_check_period_s sends one
+        ("heartbeat", ...) so head-side silence is a signal; (b) the
+        stalled-head WATCHDOG — a pending request older than
+        net_stall_timeout_s under total head silence probes with
+        hc_ping, and a probe unanswered for another full window closes
+        the conn, converting the gray stall into the clean EOF the
+        reconnect-and-replay machinery (PR 10) already survives."""
+        if not self._fd_on or self._shutting_down or self._conn_down:
+            return
+        now = time.monotonic()
+        if self._hc_period > 0 \
+                and now - self._last_head_send > self._hc_period:
+            try:
+                self._send(("heartbeat", self.worker_id_hex))
+            except Exception:
+                return
+        stall_t = self._net_stall_t
+        if stall_t <= 0 or not self._failover:
+            # Without failover the only answer to a stalled head would
+            # be this worker's exit — strictly worse than waiting.
+            return
+        with self.pending_lock:
+            oldest = min((ent[2] for ent in self.pending.values()),
+                         default=None)
+        if oldest is None:
+            self._hc_probe_sent = 0.0
+            return
+        if now - oldest < stall_t or now - self._last_head_recv < stall_t:
+            return
+        if not self._hc_probe_sent:
+            # First strike: probe.  A busy-but-alive head answers with
+            # a generic reply and the reader resets the clock.
+            self._hc_probe_sent = now
+            try:
+                self._send(("hc_ping", next(self.req_counter)))
+            except Exception:
+                pass
+            return
+        if now - self._hc_probe_sent > stall_t:
+            # Probe unanswered for a full window: the conn is stalled,
+            # not busy.  Shutdown (not just close — the reader is by
+            # precondition parked inside a blocked recv, which close()
+            # cannot wake) so its recv EOFs into _reconnect_head, which
+            # re-dials, re-registers, and replays every parked request.
+            protocol.note_net_event("stall_timeouts")
+            self._hc_probe_sent = 0.0
+            try:
+                protocol.shutdown_conn(self.conn)
+                self.conn.close()
+            except Exception:
+                pass
+
     # -- head failover: park, re-dial, re-register, replay -----------------
     def _redial(self):
         """One dial attempt to the head's listener; raises on refusal."""
-        from multiprocessing.connection import Client
-
         addr = protocol.parse_address(os.environ["RAY_TPU_ADDRESS"])
-        conn = Client(addr, authkey=bytes.fromhex(
+        return protocol.dial(addr, authkey=bytes.fromhex(
             os.environ.get("RAY_TPU_AUTHKEY", "")))
-        protocol.enable_nodelay(conn)
-        return conn
 
     def _re_handshake(self, conn):
         """Re-register this surviving process with the (restarted) head.
@@ -787,10 +873,16 @@ class _WorkerRuntime:
             # (the value's arrays keep the mapping alive).
             return object_transfer.pull_to_segment(
                 self._puller, self.shm, store, addr, descr[1], caps=caps)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 -- every failure has the same fallback
             # Agent gone or segment moved: the owner knows the truth —
             # fall back to the brokered path (which also drives recovery).
             # Forget the cached address so a restarted peer re-resolves.
+            # A STALLED pull (deadline tripped, transport retries
+            # exhausted) lands here too — that fallback is the hedge.
+            if protocol.is_stall(e) or (
+                    isinstance(e, exc.ObjectLostError)
+                    and getattr(e, "phase", None) == "stalled"):
+                protocol.note_net_event("hedged_fetches")
             self._store_addrs.pop(store, None)
             return None
 
@@ -1569,7 +1661,6 @@ def main():
     python/ray/_private/workers/default_worker.py — raylet-spawned worker
     connecting back over the raylet socket)."""
     import time
-    from multiprocessing.connection import Client
 
     from multiprocessing import AuthenticationError
 
@@ -1585,8 +1676,9 @@ def main():
     conn = None
     for attempt in range(20):
         try:
-            conn = Client(address, authkey=authkey)
-            protocol.enable_nodelay(conn)
+            # Deadline-aware dial: each attempt bounded by the connect
+            # timeout instead of the kernel default.
+            conn = protocol.dial(address, authkey=authkey)
             break
         except AuthenticationError:
             # Transient: the accept loop can drop a challenge mid-
@@ -1650,6 +1742,12 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
     # fire too.  No-op (and zero steady-state cost) when the var is
     # unset.
     recovery.maybe_arm_env_chaos("worker")
+    # Net-chaos rules (RAY_TPU_CHAOS_NET, "worker:<point>:<action>:<n>"):
+    # gray failures (stalls/drops/delays) at the protocol seam.
+    if os.environ.get("RAY_TPU_CHAOS_NET"):
+        from ray_tpu import chaos as chaos_mod
+
+        chaos_mod.maybe_arm_env_net_chaos("worker")
     global _runtime
     send_lock = threading.Lock()
     # Workers pool freed segments too (the driver routes "free_segment" back
@@ -1749,6 +1847,11 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
             rt.deliver_reply(msg[1], msg[2])
         elif tag == "reply":
             rt.deliver_reply(msg[1], msg[2])
+        elif tag == "hc_probe":
+            # Suspicion probe from the head: answer from this reader
+            # thread immediately, independent of the exec thread's
+            # state — a long task must never read as a dead link.
+            rt._send(("heartbeat", rt.worker_id_hex))
         elif tag == "free_segment":
             # The owner freed an object whose segment this worker
             # created; pool the pages for in-place reuse when no other
@@ -1771,6 +1874,7 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                 if not rt._reconnect_head():
                     os._exit(0)
             else:
+                rt.note_head_recv()  # any head message is liveness
                 handle(msg)
 
     def _queue_empty():
@@ -1841,6 +1945,9 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
                 rt.flush_spans()
                 rt._pull_registry.sweep()
                 rt.flush_xfer_stats()
+                # Failure detection: the heartbeat floor + the stalled-
+                # head watchdog ride the same periodic thread.
+                rt.heartbeat_and_watchdog()
                 direct_server.flush_replies()
             except Exception:
                 return  # conn gone; reader exits the process

@@ -47,6 +47,199 @@ def enabled() -> bool:
     return bool(os.environ.get("RAY_TPU_CHAOS"))
 
 
+# ---------------------------------------------------------- net chaos ----
+# Gray-failure injection at the ``protocol.py`` send/recv seam: where
+# the kill rules produce CLEAN failures (a process dies, its peer sees
+# EOF), these produce the failures that announce nothing — full stalls
+# (paused VM, wedged switch), silent drops (one-way partition), added
+# latency, duplicates.  The failure-detection plane (deadlines,
+# heartbeat suspicion) exists to survive exactly this class, and these
+# rules are what make it testable.
+
+def parse_net_rules(raw: str) -> List[Tuple[str, str, str, float, int]]:
+    """``RAY_TPU_CHAOS_NET`` grammar: comma-separated
+    ``role:point:action:n`` rules — in processes of ``role``
+    ("worker"/"agent"/"driver"), the ``n``-th operation hitting net
+    point ``point`` ("send", "recv", "chunk_send", or ``*`` for any)
+    triggers ``action``:
+
+    - ``stall``      — that operation and every later matching one
+                       blocks forever (the alive-but-hung peer),
+    - ``drop``       — sends are silently discarded from then on (the
+                       outbound half of a partition),
+    - ``delay-<ms>`` — every later matching operation sleeps first
+                       (the saturated link),
+    - ``dup``        — every later matching send goes out twice.
+
+    Returns (role, point, action, param, n) tuples; unparseable rules
+    are ignored (chaos must never break a production boot that
+    inherited a stray env var)."""
+    rules = []
+    for part in (raw or "").split(","):
+        bits = part.strip().split(":")
+        if len(bits) != 4:
+            continue
+        role, point, action, n = bits
+        param = 0.0
+        if action.startswith("delay-"):
+            try:
+                param = float(action[len("delay-"):])
+            except ValueError:
+                continue
+            action = "delay"
+        if action not in ("stall", "drop", "delay", "dup"):
+            continue
+        try:
+            rules.append((role, point, action, param, max(1, int(n))))
+        except ValueError:
+            continue
+    return rules
+
+
+class ChaosNet:
+    """Per-process net-fault injector installed at the protocol seam.
+
+    Two users: RAY_TPU_CHAOS_NET env rules armed at worker/agent entry
+    (one-shot per cluster via the same O_EXCL claim-file convention as
+    the kill rules, so a retried operation does not re-hit the fault
+    elsewhere and the cluster converges), and the driver-side
+    :class:`ChaosController` link methods (``stall_link``/
+    ``partition``/``restore_link``), which scope rules to ONE peer
+    connection in this process.
+
+    The hook cost is one module-global ``is None`` check per send/recv
+    until installed.  A ``stall`` parks the calling thread on the
+    rule's resume event — ``restore`` (or controller stop) releases it;
+    env-rule stalls are deliberately permanent for the process, the
+    paused-VM semantics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: List[dict] = []
+        self.net_faults = 0  # rules that actually fired
+
+    # ------------------------------------------------------- install --
+    def install(self) -> "ChaosNet":
+        from ray_tpu._private import protocol
+
+        protocol.set_net_hook(self._hook)
+        return self
+
+    def uninstall(self):
+        from ray_tpu._private import protocol
+
+        protocol.set_net_hook(None)
+        self.restore()
+
+    # --------------------------------------------------------- rules --
+    def add_rule(self, point: str, action: str, conn=None,
+                 param: float = 0.0, after: int = 1,
+                 claim: Optional[str] = None) -> dict:
+        rule = {
+            "point": point, "action": action, "conn": conn,
+            "param": param, "countdown": max(1, after),
+            "claim": claim, "armed": False, "dead": False,
+            "resume": threading.Event(),
+        }
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def restore(self, conn=None):
+        """Lift rules (all, or just one connection's): stalled threads
+        resume, drops/delays stop."""
+        with self._lock:
+            keep = []
+            for r in self._rules:
+                if conn is None or r["conn"] is conn:
+                    r["dead"] = True
+                    r["resume"].set()
+                else:
+                    keep.append(r)
+            self._rules = keep
+
+    # ---------------------------------------------------------- hook --
+    def _hook(self, point: str, conn) -> Optional[str]:
+        verdict = None
+        fire = []
+        with self._lock:
+            for r in self._rules:
+                if r["dead"]:
+                    continue
+                if r["point"] != "*" and r["point"] != point:
+                    continue
+                if r["conn"] is not None and r["conn"] is not conn:
+                    continue
+                if not r["armed"]:
+                    r["countdown"] -= 1
+                    if r["countdown"] > 0:
+                        continue
+                    if r["claim"] and not _claim_once(r["claim"]):
+                        # Another process already owns this one-shot
+                        # cluster-wide rule: this process sails through.
+                        r["dead"] = True
+                        continue
+                    r["armed"] = True
+                    self.net_faults += 1
+                fire.append(r)
+        for r in fire:
+            act = r["action"]
+            if act == "delay":
+                time.sleep(r["param"] / 1000.0)
+            elif act == "stall":
+                # Park until restored: the gray failure itself.  The
+                # socket stays open — no EOF ever announces this.
+                r["resume"].wait()
+            elif act == "drop":
+                if point == "recv":
+                    # Inbound drop = never deliver: equivalent to not
+                    # reading (the bytes sit in the kernel buffer).
+                    r["resume"].wait()
+                else:
+                    verdict = "drop"
+            elif act == "dup":
+                verdict = "dup"
+        return verdict
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"net_faults": self.net_faults,
+                    "net_rules": len(self._rules)}
+
+
+def _claim_once(claim_path: str) -> bool:
+    """O_EXCL one-shot claim (the kill rules' convention): the first
+    process to trigger a cluster-wide env rule owns it."""
+    try:
+        fd = os.open(claim_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except OSError:
+        return False
+    os.write(fd, str(os.getpid()).encode())
+    os.close(fd)
+    return True
+
+
+def maybe_arm_env_net_chaos(role: str) -> bool:
+    """Arm ``RAY_TPU_CHAOS_NET`` rules for this process (worker/agent
+    entry points call this next to ``recovery.maybe_arm_env_chaos``).
+    Each rule fires in AT MOST ONE process per cluster via the claim
+    file.  Zero cost when the env var is unset."""
+    rules = [r for r in parse_net_rules(
+        os.environ.get("RAY_TPU_CHAOS_NET", "")) if r[0] == role]
+    if not rules:
+        return False
+    session = os.environ.get("RAY_TPU_SESSION", "nosession")
+    chaos_dir = os.environ.get("RAY_TPU_CHAOS_DIR", "/tmp")
+    net = ChaosNet()
+    for r_role, point, action, param, n in rules:
+        claim = os.path.join(
+            chaos_dir,
+            f"ray_tpu_chaos_net_{session}_{r_role}_{point}_{action}_{n}")
+        net.add_rule(point, action, param=param, after=n, claim=claim)
+    net.install()
+    return True
+
+
 class ChaosController:
     """Drives fault injection against one driver runtime.
 
@@ -68,6 +261,7 @@ class ChaosController:
         # kill ourselves; the methods then raise).
         self._head = head
         self._head_kills = 0
+        self._net: Optional[ChaosNet] = None  # lazy gray-failure seam
         self._lock = threading.Lock()
         self._timers: List[threading.Timer] = []
         # name -> list of [countdown, action, args] triples
@@ -252,11 +446,16 @@ class ChaosController:
         return target.node.node_id.hex()
 
     def drop_worker_connection(self,
-                               worker_id: Optional[str] = None
-                               ) -> Optional[str]:
-        """Close a worker's control connection WITHOUT killing the
-        process — the half-death case (network partition): the head sees
-        EOF and reroutes; the orphan must exit on its own."""
+                               worker_id: Optional[str] = None,
+                               stall: bool = False) -> Optional[str]:
+        """Take a worker's control connection away WITHOUT killing the
+        process.  Default (``stall=False``): close it — the half-death
+        case whose EOF the head discovers immediately and reroutes.
+        ``stall=True`` is the GRAY variant: the socket stays open but
+        the head stops reading it (and the worker's results rot in the
+        kernel buffer) — no EOF ever fires, and only the heartbeat
+        suspicion machinery can discover it.  One API, A/B-able clean
+        vs gray."""
         victim = None
         with self._rt.lock:
             for node in self._rt.nodes.values():
@@ -273,11 +472,72 @@ class ChaosController:
             if victim is None:
                 return None
             self._rt.chaos_kills += 1
-        try:
-            victim.conn.close()
-        except Exception:
-            pass
+        if stall:
+            # Hold the socket open, stop reading: the head-side reader
+            # parks inside the net hook; sends to the worker are
+            # swallowed so its gets/waits starve too.  net_faults
+            # counts it as an injected gray fault.
+            net = self._ensure_net()
+            net.add_rule("recv", "stall", conn=victim.conn)
+            net.add_rule("send", "drop", conn=victim.conn)
+        else:
+            try:
+                victim.conn.close()
+            except Exception:
+                pass
         return victim.worker_id.hex()
+
+    # ------------------------------------------------------ net faults --
+    def _ensure_net(self) -> ChaosNet:
+        with self._lock:
+            if self._net is None:
+                self._net = ChaosNet().install()
+            return self._net
+
+    def stall_link(self, node_id: Optional[str] = None) -> Optional[str]:
+        """Full gray stall of the head<->agent link of one node: the
+        head stops reading the agent's messages (heartbeats included)
+        and its sends are silently swallowed — both processes stay
+        alive, nothing EOFs.  The suspicion machine is what must notice.
+        Returns the node id hex, or None."""
+        with self._rt.lock:
+            target = self._pick_agent_locked(node_id)
+        if target is None:
+            return None
+        net = self._ensure_net()
+        net.add_rule("recv", "stall", conn=target.conn)
+        net.add_rule("send", "drop", conn=target.conn)
+        return target.node.node_id.hex()
+
+    def partition(self, node_id: Optional[str] = None,
+                  direction: str = "in") -> Optional[str]:
+        """One-way partition of a node's head link: ``direction="in"``
+        drops everything the agent sends (the head goes deaf to it —
+        heartbeat silence with a perfectly healthy agent process);
+        ``"out"`` silently swallows the head's sends instead.  Returns
+        the node id hex, or None."""
+        with self._rt.lock:
+            target = self._pick_agent_locked(node_id)
+        if target is None:
+            return None
+        net = self._ensure_net()
+        if direction == "in":
+            net.add_rule("recv", "stall", conn=target.conn)
+        else:
+            net.add_rule("send", "drop", conn=target.conn)
+        return target.node.node_id.hex()
+
+    def restore_link(self, node_id: Optional[str] = None):
+        """Lift controller-installed link faults (one node's, or all)."""
+        if self._net is None:
+            return
+        if node_id is None:
+            self._net.restore()
+            return
+        with self._rt.lock:
+            target = self._pick_agent_locked(node_id)
+        if target is not None:
+            self._net.restore(target.conn)
 
     def attach_head(self, head) -> None:
         """Late-bind the head manager (the pytest fixture constructs the
@@ -312,7 +572,7 @@ class ChaosController:
 
     # ------------------------------------------------------------ admin --
     def stats(self) -> Dict[str, int]:
-        out = {"chaos_kills": 0}
+        out = {"chaos_kills": 0, "net_faults": 0}
         try:
             with self._rt.lock:
                 out["chaos_kills"] = self._rt.chaos_kills
@@ -322,6 +582,8 @@ class ChaosController:
             pass
         with self._lock:
             out["head_kills"] = self._head_kills
+            if self._net is not None:
+                out["net_faults"] = self._net.stats()["net_faults"]
         return out
 
     def stop(self):
@@ -330,9 +592,12 @@ class ChaosController:
             timers, self._timers = self._timers, []
             self._sync_actions.clear()
             self._pending.clear()
+            net, self._net = self._net, None
         for t in timers:
             t.cancel()
         recovery.set_chaos_hook(None)
+        if net is not None:
+            net.uninstall()  # stalled threads resume; rules lift
         self._pending_ev.set()
 
     def __enter__(self):

@@ -66,6 +66,17 @@ RTL401  lock-acquire-no-with
     timeout try-locks (``acquire(False)``, ``acquire(timeout=...)``) are
     exempt because ``with`` cannot express them.
 
+RTL403  raw-recv-outside-deadline-core
+    A raw connection/socket receive (``conn.recv_bytes()``,
+    ``conn.recv_bytes_into()``, ``sock.recv()``) anywhere outside the
+    deadline-aware protocol core.  Raw receives bypass the
+    failure-detection plane entirely: no zero-progress deadline can ever
+    trip, so a stalled-but-alive peer (gray failure) wedges the calling
+    thread forever.  Go through ``protocol.recv`` / ``protocol.
+    recv_deadline``, or arm the socket with ``protocol.
+    set_conn_deadline`` around the raw loop (the object-transfer range
+    loops do this) and suppress with the reason.
+
 RTL402  blocking-io-under-runtime-lock
     A blocking socket operation (``protocol.send/recv``,
     ``*.send_bytes/recv_bytes``, ``conn/agent/worker.send/recv``) or a
@@ -100,6 +111,8 @@ RULES: Dict[str, str] = {
               "paths",
     "RTL402": "blocking socket send/recv or payload (un)pickling while "
               "holding a runtime lock stalls every other acquirer",
+    "RTL403": "raw conn/sock receive outside the deadline-aware protocol "
+              "core can hang forever on a stalled peer",
 }
 
 # RTL402: the runtime/table locks the rule guards (deliberately NOT
@@ -368,7 +381,35 @@ class _Linter(ast.NodeVisitor):
         self._check_async_blocking(node)
         self._check_lock_acquire(node)
         self._check_lock_io(node)
+        self._check_raw_recv(node)
         self.generic_visit(node)
+
+    def _check_raw_recv(self, node: ast.Call):
+        """RTL403 — raw connection/socket receive outside the
+        deadline-aware protocol core.  ``recv_bytes``/``recv_bytes_into``
+        on a connection-ish receiver, or ``recv`` on a socket-named one,
+        can block forever on a stalled-but-alive peer; the deadline core
+        (``protocol.recv``/``recv_deadline``/``set_conn_deadline``) is
+        the one place that bounds them.  Deliberately-armed raw loops
+        suppress with the arming site as the reason."""
+        chain = _attr_chain(node.func)
+        if not chain or len(chain) < 2:
+            return
+        leaf, owner = chain[-1], chain[-2]
+        if leaf in ("recv_bytes", "recv_bytes_into") \
+                and _SOCKISH_RE.search(owner.lower()):
+            what = f"{owner}.{leaf}()"
+        elif leaf == "recv" and "sock" in owner.lower():
+            what = f"{owner}.{leaf}()"
+        else:
+            return
+        self._emit(
+            node, "RTL403",
+            f"raw '{what}' bypasses the deadline-aware protocol core — "
+            "a stalled (alive-but-hung) peer wedges this thread forever; "
+            "use protocol.recv/recv_deadline, or arm "
+            "protocol.set_conn_deadline around the loop and suppress "
+            "with the arming site as the reason")
 
     def _check_lock_io(self, node: ast.Call):
         """RTL402 — blocking socket IO / payload pickling while a runtime

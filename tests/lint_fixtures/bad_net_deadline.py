@@ -1,0 +1,21 @@
+"""RTL403 fixture: raw connection/socket receives outside the
+deadline-aware protocol core — each can hang forever on a
+stalled-but-alive peer (gray failure) because no zero-progress deadline
+is ever armed."""
+
+
+class Puller:
+    def pull_header(self, conn):
+        return conn.recv_bytes()  # EXPECT: RTL403
+
+    def pull_range(self, conn, view, off, n):
+        got = 0
+        while got < n:
+            got += conn.recv_bytes_into(view, off + got)  # EXPECT: RTL403
+        return got
+
+    def pull_nested(self):
+        return self._conn.recv_bytes()  # EXPECT: RTL403
+
+    def read_raw(self, sock):
+        return sock.recv(4096)  # EXPECT: RTL403

@@ -1,0 +1,29 @@
+"""RTL403 negative space: receives that go through the deadline-aware
+protocol core — either the wrapped primitives (``protocol.recv`` /
+``protocol.recv_deadline``) or a raw loop explicitly armed with
+``set_conn_deadline`` and suppressed with the arming site as the
+reason."""
+
+from ray_tpu._private import protocol
+
+
+class Puller:
+    def pull_msg(self, conn):
+        return protocol.recv(conn)
+
+    def pull_msg_bounded(self, conn, timeout):
+        return protocol.recv_deadline(conn, timeout)
+
+    def pull_range(self, conn, view, off, n):
+        protocol.set_conn_deadline(conn, 15.0)
+        try:
+            got = 0
+            while got < n:
+                got += conn.recv_bytes_into(view, off + got)  # noqa: RTL403 -- deadline armed two lines up
+            return got
+        finally:
+            protocol.set_conn_deadline(conn, None)
+
+    def drain_queue(self, inbox):
+        # Non-socket receivers are not the rule's business.
+        return inbox.recv_bytes()

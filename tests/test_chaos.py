@@ -50,6 +50,7 @@ def test_controller_at_syncpoint_fires_nth():
 
     ctl._lock = threading.Lock()
     ctl._timers = []
+    ctl._net = None
     ctl._sync_actions = {}
     ctl._pending = []
     ctl._pending_ev = threading.Event()
