@@ -177,6 +177,28 @@ class Config:
     # finished — the legacy window semantics), the measured A/B
     # baseline.  Read in the REPLICA process (rides _worker_config_env).
     continuous_batching: bool = True
+    # --- Serving memory plane (reference: vLLM PagedAttention SOSP'23 +
+    # Leviathan et al. ICML'23 speculative decoding). ---
+    # Master switch for the paged KV cache: a deployment that attaches a
+    # kv_cache.PagedKVEngine gets block-granular admission (a request is
+    # admitted when its KV BLOCKS fit, not a max-length slot) and the
+    # paged decode mode in replicas that support it
+    # (serve/tpu_replica.py).  Off = the byte-identical PR 8 dense
+    # engine: the attached engine is ignored, every serving-memory
+    # counter (kv_blocks_* / prefix_* / spec_* / cow_copies) stays zero.
+    # Read in the REPLICA process (rides _worker_config_env).
+    paged_kv: bool = False
+    # Shared-prefix reuse on the paged cache: prompt-prefix-hash keyed
+    # block chains with refcounts and copy-on-write divergence; requests
+    # sharing a system prompt map the same physical blocks.  Only
+    # meaningful with paged_kv on.
+    prefix_caching: bool = True
+    # Speculative decoding: a draft model proposes this many tokens per
+    # step and the target verifies them in one batched forward
+    # (exact-match acceptance keeps greedy output bitwise-unchanged).
+    # 0 disables.  Only meaningful with paged_kv on, read by replicas
+    # that implement a draft path.
+    speculative_k: int = 0
     # Autoscale smoothing: the controller scales on each handle's PEAK
     # ongoing-request count inside this look-back window.
     serve_metric_lookback_s: float = 3.0

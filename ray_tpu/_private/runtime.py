@@ -2616,6 +2616,13 @@ class Runtime:
             # this env namespace.
             "RAY_TPU_CONTINUOUS_BATCHING":
                 "1" if self.config.continuous_batching else "0",
+            # Serving memory plane: all three are read in the REPLICA
+            # worker (paged admission + prefix reuse + draft length).
+            "RAY_TPU_PAGED_KV":
+                "1" if self.config.paged_kv else "0",
+            "RAY_TPU_PREFIX_CACHING":
+                "1" if self.config.prefix_caching else "0",
+            "RAY_TPU_SPECULATIVE_K": str(self.config.speculative_k),
             "RAY_TPU_SERVE_METRIC_LOOKBACK_S":
                 str(self.config.serve_metric_lookback_s),
             "RAY_TPU_SERVE_DOWNSCALE_DELAY_S":

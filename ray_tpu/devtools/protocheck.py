@@ -654,6 +654,7 @@ class Analysis:
         self._check_caps()
         self._check_knobs()
         self._check_counters()
+        self._check_serve_counters()
         self._check_locks()
         # One edge/site can be reached through several call paths or
         # held-lock levels: report it once.
@@ -1037,6 +1038,77 @@ class Analysis:
                             f"aggregator (runtime.py:{agg_line}) drops "
                             f"it — every shipped counter must reach "
                             f"transfer_stats()")
+
+    def _check_serve_counters(self):
+        """Serve-plane twin of _check_counters: every key a serve
+        batcher's ``stats()`` ships (serve/batching.py,
+        serve/continuous.py, and the kv engine's ``stats_locked()``,
+        whose dict is merged into the batcher's) must SURVIVE the
+        controller rollup — appear in ``serving_stats`` in
+        serve/api.py, either read off a replica row (``b[...]`` /
+        ``b.get(...)``) or recomputed into the aggregate dict.  A
+        counter added to a batcher but dropped by the rollup is
+        invisible at ``serve.serving_stats()`` — exactly the bug class
+        the xfer-stats rule pins for the head."""
+        sep = os.sep
+        api = None
+        for mod in self.modules:
+            if not mod.is_test and mod.path.endswith(
+                    f"serve{sep}api.py"):
+                api = mod
+                break
+        if api is None:
+            return
+        # Keys surviving the rollup: string constants subscripted /
+        # .get()'d / assigned anywhere inside serving_stats defs, plus
+        # dict-literal keys (the aggregate's shape).
+        survived: Set[str] = set()
+        roll_line = None
+        for fn in api.fns:
+            if fn.name != "serving_stats":
+                continue
+            roll_line = roll_line or fn.node.lineno
+            for sub in ast.walk(fn.node):
+                if isinstance(sub, ast.Subscript) \
+                        and isinstance(sub.slice, ast.Constant) \
+                        and isinstance(sub.slice.value, str):
+                    survived.add(sub.slice.value)
+                elif isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "get" and sub.args \
+                        and isinstance(sub.args[0], ast.Constant) \
+                        and isinstance(sub.args[0].value, str):
+                    survived.add(sub.args[0].value)
+                elif isinstance(sub, (ast.Dict, ast.Tuple)):
+                    for k in (sub.keys if isinstance(sub, ast.Dict)
+                              else sub.elts):
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            survived.add(k.value)
+        if roll_line is None:
+            return
+        for mod in self.modules:
+            if mod.is_test or f"{sep}serve{sep}" not in mod.path \
+                    or mod.path.endswith(f"serve{sep}api.py"):
+                continue
+            for fn in mod.fns:
+                if fn.name not in ("stats", "stats_locked"):
+                    continue
+                for sub in ast.walk(fn.node):
+                    if not isinstance(sub, ast.Dict):
+                        continue
+                    for k in sub.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str) \
+                                and k.value not in survived:
+                            self._emit(
+                                mod.path, sub.lineno, 0, "RTL504",
+                                f"serve batcher counter {k.value!r} is "
+                                f"dropped by the controller rollup "
+                                f"(serve/api.py:{roll_line} "
+                                f"serving_stats) — every shipped "
+                                f"counter must survive head "
+                                f"aggregation")
 
     # -- RTL505: lock order -----------------------------------------------
     def _check_locks(self):

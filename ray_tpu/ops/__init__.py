@@ -10,11 +10,14 @@ as the CPU fallback.
 """
 
 from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.paged_attention import (
+    paged_attention, paged_attention_reference)
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.ops.ulysses import ulysses_attention
 from ray_tpu.ops.layers import rms_norm, rope, apply_rope, swiglu
 
 __all__ = [
-    "flash_attention", "mha_reference", "ring_attention",
+    "flash_attention", "mha_reference", "paged_attention",
+    "paged_attention_reference", "ring_attention",
     "ulysses_attention", "rms_norm", "rope", "apply_rope", "swiglu",
 ]

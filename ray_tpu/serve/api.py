@@ -492,12 +492,22 @@ class ServeController:
                     "scale_ups": ups,
                     "scale_downs": downs,
                 }
+        # Serving-memory counters (paged KV plane): summed across every
+        # replica batcher; zero when the paged_kv knob is off or no
+        # engine is attached (the batcher then omits the keys and
+        # .get() keeps the zeros — the knob-off pin).
+        _KV_SUM = ("kv_blocks_total", "kv_blocks_used", "prefix_hits",
+                   "prefix_blocks_shared", "cow_copies", "spec_proposed",
+                   "spec_accepted", "tokens_emitted", "admission_parks",
+                   "admission_rejects")
         out = {}
         for n, s in snap.items():
             reps = s.pop("replicas")
             agg = {"replicas": len(reps), "queued": 0, "steps": 0,
                    "admitted": 0, "retired": 0, "step_errors": 0,
-                   "batch_occupancy": 0.0, **s}
+                   "batch_occupancy": 0.0, "max_batch_size": 0,
+                   "kv_occupancy": 0.0, "tokens_per_step": 0.0, **s}
+            agg.update({k: 0 for k in _KV_SUM})
             occ_steps = 0.0
             modes = set()
             # Replica RPCs run OUTSIDE _lock (a saturated replica must
@@ -525,11 +535,23 @@ class ServeController:
                     agg["retired"] += b["retired"]
                     agg["step_errors"] += b["step_errors"]
                     occ_steps += b["batch_occupancy"] * b["steps"]
+                    # The mode string carries the paged flag
+                    # ("continuous+paged"), so the rollup's mode/mixed
+                    # logic reports the memory plane too.
                     modes.add(b["mode"])
+                    agg["max_batch_size"] = max(agg["max_batch_size"],
+                                                b["max_batch_size"])
+                    for k in _KV_SUM:
+                        agg[k] += b.get(k, 0)
             if modes:
                 agg["mode"] = modes.pop() if len(modes) == 1 else "mixed"
             if agg["steps"]:
                 agg["batch_occupancy"] = round(occ_steps / agg["steps"], 3)
+                agg["tokens_per_step"] = round(
+                    agg["tokens_emitted"] / agg["steps"], 3)
+            if agg["kv_blocks_total"]:
+                agg["kv_occupancy"] = round(
+                    agg["kv_blocks_used"] / agg["kv_blocks_total"], 3)
             out[n] = agg
         return out if name is None else out.get(name, {})
 

@@ -240,12 +240,21 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
                 from ray_tpu._private.config import GLOBAL_CONFIG
                 from ray_tpu.serve.continuous import _ContinuousBatcher
 
-                # The switch is read in the REPLICA process (it rides
-                # _worker_config_env): off = one-shot driving of the
-                # same step function, the measured A/B baseline.
+                # The switches are read in the REPLICA process (they
+                # ride _worker_config_env): continuous off = one-shot
+                # driving of the same step function, the measured A/B
+                # baseline.  paged_kv on + an instance-attached
+                # PagedKVEngine (the ``serve_kv_engine`` attribute)
+                # switches admission from max_batch_size slots to KV
+                # blocks; with the knob off the engine is ignored and
+                # the batcher is byte-identical to the dense PR 8 one.
+                kv = None
+                if GLOBAL_CONFIG.paged_kv:
+                    holder = instance if instance is not None else fn
+                    kv = getattr(holder, "serve_kv_engine", None)
                 return _ContinuousBatcher(
                     fn, instance, max_batch_size, batch_wait_timeout_s,
-                    continuous=GLOBAL_CONFIG.continuous_batching)
+                    continuous=GLOBAL_CONFIG.continuous_batching, kv=kv)
             return _Batcher(fn, instance, max_batch_size,
                             batch_wait_timeout_s)
 

@@ -59,13 +59,16 @@ class Slot:
     Everything else is scheduler-internal.
     """
 
-    __slots__ = ("request", "state", "steps", "_done", "_result",
-                 "_error", "_event", "_owner")
+    __slots__ = ("request", "state", "steps", "kv", "_done",
+                 "_result", "_error", "_event", "_owner")
 
     def __init__(self, request: Any):
         self.request = request
         self.state: Any = None   # per-request state, carried across steps
         self.steps = 0           # iterations this request has been live
+        # Paged-KV plan (kv_cache.SlotKV), set at admission when the
+        # batcher carries a PagedKVEngine; None on the dense path.
+        self.kv: Any = None
         self._done = False
         self._result: Any = None
         self._error: Optional[BaseException] = None
@@ -109,14 +112,26 @@ class _ContinuousBatcher:
     _BACKSTOP_S = 1.0
 
     def __init__(self, fn: Callable, instance, max_batch_size: int,
-                 batch_wait_timeout_s: float, continuous: bool = True):
+                 batch_wait_timeout_s: float, continuous: bool = True,
+                 kv=None):
         self._fn = fn
         self._instance = instance
         self._max = max(1, int(max_batch_size))
         self._timeout = batch_wait_timeout_s
         self._continuous = continuous
-        # LEAF lock (see module docstring): queue + counters only.
+        # Paged-KV admission engine (kv_cache.PagedKVEngine) or None.
+        # With an engine attached, admission is bounded by free KV
+        # BLOCKS (plus the engine's slot cap) instead of
+        # max_batch_size: a request is admitted when its whole block
+        # budget fits, and parks at the queue head otherwise.  The
+        # engine adopts THIS batcher's leaf lock as its guard, so block
+        # accounting and admission re-checks happen under one lock.
+        self._kv = kv
+        # LEAF lock (see module docstring): queue + counters + block
+        # accounting only.
         self._lock = threading.Lock()  # lock-order: leaf
+        if kv is not None:
+            kv.bind(self._lock)
         self._queue: deque = deque()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -190,21 +205,49 @@ class _ContinuousBatcher:
         return slot._result
 
     # ---------------------------------------------------------- scheduler --
-    def _admit_locked(self, live: List[Slot]) -> None:
+    def _admit_locked(self, live: List[Slot]) -> List[tuple]:
         me = threading.current_thread()
-        while self._queue and len(live) < self._max:
-            s = self._queue.popleft()
+        # Paged admission: bounded by free KV BLOCKS + the engine's slot
+        # cap, not max_batch_size.  Availability is (re-)checked under
+        # this leaf lock at every boundary; a request whose block budget
+        # does not fit PARKS at the queue head (FIFO — retiring requests
+        # free blocks and the next boundary re-checks) instead of
+        # erroring.  The one exception: a budget no pool state could
+        # ever satisfy (RequestTooLarge) is popped and returned for the
+        # caller to FAIL outside this (leaf) lock — parking it would
+        # wedge the queue head forever.
+        doomed: List[tuple] = []
+        cap = self._kv.max_slots if self._kv is not None else self._max
+        while self._queue and len(live) < cap:
+            s = self._queue[0]
+            if self._kv is not None:
+                try:
+                    if not self._kv.try_admit_locked(s):
+                        break
+                except Exception as err:  # noqa: BLE001 — a malformed
+                    # request (sizing hook blew up) or an oversized one
+                    # must doom THAT slot, not kill the scheduler: the
+                    # bad slot would stay at the queue head and every
+                    # respawned scheduler would die on it again.
+                    self._queue.popleft()
+                    doomed.append((s, err))
+                    continue
+            self._queue.popleft()
             s._owner = me
             live.append(s)
+        return doomed
 
     def _loop(self) -> None:
         live: List[Slot] = []
         while True:
+            doomed = []
             with self._lock:
                 if self._continuous or not live:
                     # Continuous: refill freed slots every boundary.
                     # One-shot: admit only into an empty batch.
-                    self._admit_locked(live)
+                    doomed = self._admit_locked(live)
+            for s, err in doomed:  # events fire OUTSIDE the leaf lock
+                s._fail(err)
             if not live:
                 # Idle: park until a request arrives (clear-then-check
                 # so a submit racing this window still wakes us).
@@ -214,20 +257,23 @@ class _ContinuousBatcher:
                 if empty:
                     self._wake.wait()
                 continue
+            cap = self._kv.max_slots if self._kv is not None else self._max
             if not self._continuous and self._timeout > 0 \
                     and live and live[0].steps == 0 \
-                    and len(live) < self._max:
+                    and len(live) < cap:
                 # Legacy window: a fresh one-shot batch below max waits
                 # out the batching window for followers before step 0.
                 deadline = time.monotonic() + self._timeout
-                while len(live) < self._max:
+                while len(live) < cap:
                     left = deadline - time.monotonic()
                     if left <= 0:
                         break
                     self._wake.wait(left)
                     self._wake.clear()
                     with self._lock:
-                        self._admit_locked(live)
+                        doomed = self._admit_locked(live)
+                    for s, err in doomed:
+                        s._fail(err)
             try:
                 if self._instance is not None:
                     self._fn(self._instance, live)
@@ -237,6 +283,11 @@ class _ContinuousBatcher:
                 with self._lock:
                     self._step_errors += 1
                     self._steps += 1
+                    if self._kv is not None:
+                        # Failed slots free their KV blocks too — a
+                        # crashing step function must not leak the pool.
+                        for s in live:
+                            self._kv.retire_locked(s)
                 for s in live:
                     s._fail(err)
                 live = []
@@ -249,6 +300,12 @@ class _ContinuousBatcher:
                 self._steps += 1
                 self._occupied_slot_steps += len(live) + len(finished)
                 self._retired += len(finished)
+                if self._kv is not None:
+                    # Free on retire, under the same leaf lock the
+                    # admission check runs under: the next boundary's
+                    # block-availability re-check sees these blocks.
+                    for s in finished:
+                        self._kv.retire_locked(s)
             # Events fire OUTSIDE the lock (leaf convention).
             for s in finished:
                 s._event.set()
@@ -258,7 +315,7 @@ class _ContinuousBatcher:
         with self._lock:
             steps = self._steps
             occ = (self._occupied_slot_steps / steps) if steps else 0.0
-            return {
+            out = {
                 "mode": "continuous" if self._continuous else "oneshot",
                 "steps": steps,
                 "batch_occupancy": round(occ, 3),
@@ -268,3 +325,13 @@ class _ContinuousBatcher:
                 "queued": len(self._queue),
                 "step_errors": self._step_errors,
             }
+            if self._kv is not None:
+                # Serving-memory plane: block occupancy, prefix reuse,
+                # and speculative-decode counters ride the same stats
+                # dict (rolled up per deployment by the controller).
+                out["mode"] += "+paged"
+                kv = self._kv.stats_locked()
+                out.update(kv)
+                out["tokens_per_step"] = round(
+                    kv["tokens_emitted"] / steps, 3) if steps else 0.0
+            return out

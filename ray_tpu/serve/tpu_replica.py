@@ -33,8 +33,33 @@ every matmul below float32's 2^24 integer window is EXACT, so the
 decoded chains are bit-independent of BLAS/XLA reduction order and the
 test tree can pin them against a plain host-side reference loop.
 
-Request format: ``{"prompt": int, "tokens": int}`` → list of ``tokens``
-greedily decoded token ids.
+PAGED DECODE MODE (``paged_kv`` knob; reference: vLLM PagedAttention
+SOSP'23 + Leviathan et al. ICML'23): per-request decode state moves
+from a dense ``(MAX_BATCH, embed)`` row reservation into a pool of
+fixed-size KV blocks (``kv_cache.PagedKVEngine``) — admission is then
+bounded by blocks (tokens actually resident), not slots, and the
+batcher packs skewed-length batches.  Each position's value row is the
+emitted token's embedding; every step reads the live requests' LAST
+rows back THROUGH the paged cache with the ``ops.paged_attention``
+pallas kernel (``window=1`` — softmax over one position is exactly 1.0,
+so the gather is bitwise) and advances each chain with the same
+integer-exact ``x @ W`` argmax the dense path uses: greedy chains stay
+bitwise-identical to ``reference_decode``.  On top of it:
+
+- Shared-prefix reuse (``prefix_caching``): prompt token lists are
+  prefilled once; block chains are registered per prompt-prefix hash
+  and later requests map the SAME physical blocks (copy-on-write on
+  first divergence inside a shared partial block).
+- Speculative decoding (``speculative_k=k``): a draft model (a
+  perturbed integer copy of the projection — cheap, mostly-agreeing)
+  proposes k tokens per step host-side; the target verifies all of
+  them in ONE batched forward and the accepted prefix plus the
+  correction token retire together — multiple tokens per replica step,
+  bitwise-unchanged greedy output because acceptance is exact-match.
+
+Request format: ``{"prompt": int | [int, ...], "tokens": int}`` → list
+of ``tokens`` greedily decoded token ids (the dense path takes the
+``int`` form only; decode continues from the LAST prompt token).
 """
 
 from __future__ import annotations
@@ -49,7 +74,12 @@ MAX_BATCH = 8
 class MeshShardedDecoder:
     """Deployment-ready greedy decoder with mesh-resident weights."""
 
-    def __init__(self, embed: int = 32, vocab: int = 64, seed: int = 0):
+    def __init__(self, embed: int = 32, vocab: int = 64, seed: int = 0,
+                 paged: Optional[bool] = None, kv_blocks: int = 32,
+                 kv_block_size: int = 8, max_slots: int = 16,
+                 speculative_k: Optional[int] = None,
+                 prefix_caching: Optional[bool] = None,
+                 use_kernel: bool = True):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -103,6 +133,51 @@ class MeshShardedDecoder:
         # Last dispatched step: (token device array, [(row, slot)]).
         self._pending = None
 
+        # -- paged decode mode (serving memory plane) ------------------
+        from ray_tpu._private.config import GLOBAL_CONFIG as _CFG
+
+        self._paged = _CFG.paged_kv if paged is None else paged
+        self._spec_k = max(0, (_CFG.speculative_k if speculative_k is None
+                               else speculative_k))
+        self._use_kernel = use_kernel
+        if self._paged:
+            from ray_tpu.serve.kv_cache import PagedKVEngine
+
+            self._kv_engine = PagedKVEngine(
+                kv_blocks, kv_block_size, tokens_for=self._tokens_for,
+                prefix_caching=(_CFG.prefix_caching if prefix_caching
+                                is None else prefix_caching),
+                max_slots=max_slots)
+            # The batching decorator picks this attribute up and wires
+            # block-gated admission into the continuous batcher.
+            self.serve_kv_engine = self._kv_engine
+            # Device-resident paged value cache: one (block_size, 1,
+            # embed) page per block, replicated over the mesh (read by
+            # position — gather-heavy, like the embedding table).
+            self._kv_cache = jax.device_put(
+                np.zeros((kv_blocks, kv_block_size, 1, embed),
+                         np.float32), self._in_sharding)
+            # Draft model: a perturbed integer copy of the projection —
+            # mostly agrees with the target (that is the whole game of
+            # speculative decoding), still integer-exact.
+            kd = jax.random.PRNGKey(seed + 1)
+            self._wd_host = np.asarray(
+                self._w_host
+                + np.asarray(jnp.round(
+                    jax.random.normal(kd, self._w_host.shape) * 0.7)),
+                np.float32)
+
+    # -- paged-mode helpers -------------------------------------------------
+    def _tokens_for(self, request) -> Any:
+        """Admission sizing hook: (prompt token tuple, max new tokens)."""
+        body = request or {}
+        prompt = body.get("prompt", 0)
+        if isinstance(prompt, (list, tuple)):
+            ids = tuple(int(t) % self._vocab for t in prompt) or (0,)
+        else:
+            ids = (int(prompt) % self._vocab,)
+        return ids, max(1, int(body.get("tokens", 1)))
+
     # -- continuous decode step (called by the batching engine) ------------
     def _force_pending(self):
         """Force the previously dispatched step's tokens (device→host),
@@ -122,9 +197,144 @@ class MeshShardedDecoder:
             if len(st["out"]) >= st["need"]:
                 slot.finish(list(st["out"][:st["need"]]))
 
+    # -- paged decode step --------------------------------------------------
+    def _apply_cache_writes(self, cow_pairs, blocks, offs, vals):
+        """Device updates for one phase: copy-on-write block copies
+        FIRST (they must preserve shared content before private writes
+        land), then one scatter of the new value rows."""
+        import jax.numpy as jnp
+        np = self._np
+        if cow_pairs:
+            olds = jnp.asarray([o for o, _ in cow_pairs], jnp.int32)
+            news = jnp.asarray([n for _, n in cow_pairs], jnp.int32)
+            self._kv_cache = self._kv_cache.at[news].set(
+                self._kv_cache[olds])
+        if blocks:
+            self._kv_cache = self._kv_cache.at[
+                jnp.asarray(blocks, jnp.int32),
+                jnp.asarray(offs, jnp.int32), 0].set(
+                    jnp.asarray(np.stack(vals)))
+
+    def _read_last(self, live):
+        """Gather every live request's LAST value row back through the
+        paged cache — the ops.paged_attention block-table data path.
+        ``window=1`` makes the softmax exactly 1.0, so the result is
+        bitwise the stored row (= emb[last token])."""
+        import jax.numpy as jnp
+
+        from ray_tpu.ops.paged_attention import (
+            paged_attention, paged_attention_reference)
+        np = self._np
+        eng = self._kv_engine
+        tables = [eng.block_table(s) for s in live]
+        width = max(len(t) for t in tables)
+        bt = np.zeros((len(live), width), np.int32)
+        for i, t in enumerate(tables):
+            bt[i, : len(t)] = t
+        cl = np.asarray([s.state["pos"] for s in live], np.int32)
+        q = np.zeros((len(live), 1, self._embed), np.float32)
+        fn = paged_attention if self._use_kernel \
+            else paged_attention_reference
+        out = fn(jnp.asarray(q), self._kv_cache, self._kv_cache,
+                 jnp.asarray(bt), jnp.asarray(cl), window=1)
+        return np.asarray(out)[:, 0, :]
+
+    def _paged_step(self, slots):
+        """One iteration of the paged engine: prefill joiners into their
+        blocks (skipping shared-prefix positions), read last rows via
+        the paged kernel, draft + verify ``spec_k`` tokens in one
+        batched forward, and retire the accepted prefix."""
+        import jax.numpy as jnp
+        np = self._np
+        eng = self._kv_engine
+        k = self._spec_k
+        # Phase 1: join + prefill.  Positions [0, n_cached) are mapped
+        # from the prefix cache and never rewritten; the rest of the
+        # prompt scatters into this request's (fresh or CoW'd) blocks.
+        cow, wb, wo, wv = [], [], [], []
+        joiners = []
+        for s in slots:
+            if s.state is not None:
+                continue
+            kvp = s.kv
+            s.state = {"pos": len(kvp.prompt), "out": [],
+                       "need": kvp.max_new, "last": kvp.prompt[-1]}
+            lo = kvp.n_cached
+            if lo < len(kvp.prompt):
+                writes, cw = eng.plan_writes(s, lo, len(kvp.prompt) - lo)
+                cow += cw
+                for (blk, off), tok in zip(writes, kvp.prompt[lo:]):
+                    wb.append(blk)
+                    wo.append(off)
+                    wv.append(self._emb_host[tok])
+            joiners.append(s)
+        self._apply_cache_writes(cow, wb, wo, wv)
+        for s in joiners:
+            # Publish AFTER the prefill scatter: a prefix-cache entry
+            # must never alias unwritten blocks.
+            eng.register_prefix(s)
+        live = [s for s in slots if not s.finished]
+        if not live:
+            return
+        # Phase 2: last rows through the paged cache (bitwise gather).
+        last = self._read_last(live)                       # (B, embed)
+        # Phase 3: draft k tokens per request (host, integer-exact),
+        # then verify ALL of them in ONE batched target forward:
+        # position j's logits come from token j-1's value row, so row 0
+        # is the cache-gathered last row and rows 1..k are the drafts'
+        # embeddings.
+        drafts = []
+        for s in live:
+            t = s.state["last"]
+            chain = []
+            for _ in range(k):
+                t = int(np.argmax(self._emb_host[t] @ self._wd_host))
+                chain.append(t)
+            drafts.append(chain)
+        verify = np.empty((len(live), k + 1, self._embed), np.float32)
+        verify[:, 0, :] = last
+        for i, chain in enumerate(drafts):
+            for j, t in enumerate(chain):
+                verify[i, j + 1] = self._emb_host[t]
+        logits = jnp.asarray(verify) @ self._w     # sharded over "model"
+        target = np.asarray(jnp.argmax(logits, axis=-1))   # (B, k+1)
+        # Phase 4: exact-match acceptance — emitted tokens are the
+        # matching draft prefix plus the target's correction token,
+        # which is by construction the plain greedy chain.
+        cow, wb, wo, wv = [], [], [], []
+        for i, s in enumerate(live):
+            st = s.state
+            room = st["need"] - len(st["out"])
+            usable = min(k, room - 1)
+            m = 0
+            while m < usable and drafts[i][m] == int(target[i, m]):
+                m += 1
+            emit = drafts[i][:m] + [int(target[i, m])]
+            if k:
+                eng.note_spec(usable, m)
+            writes, cw = eng.plan_writes(s, st["pos"], len(emit))
+            cow += cw
+            for (blk, off), tok in zip(writes, emit):
+                wb.append(blk)
+                wo.append(off)
+                wv.append(self._emb_host[tok])
+            st["out"] += emit
+            st["pos"] += len(emit)
+            st["last"] = emit[-1]
+            eng.note_tokens(len(emit))
+            if len(st["out"]) >= st["need"]:
+                s.finish(list(st["out"][: st["need"]]))
+        self._apply_cache_writes(cow, wb, wo, wv)
+
     @batch(mode="continuous", max_batch_size=MAX_BATCH,
            batch_wait_timeout_s=0.002)
     def _decode(self, slots):
+        # Paged dispatch requires the batcher to have wired the engine
+        # (slots then carry SlotKV plans): with the paged_kv knob off
+        # the batcher ignores serve_kv_engine and admission is dense, so
+        # a paged=True instance must fall back to the dense path too.
+        if self._paged and slots and slots[0].kv is not None:
+            return self._paged_step(slots)
         jax, np = self._jax, self._np
         # Retired slots free their rows at the boundary (their final
         # token was forced LAST step; the batcher has already refilled
@@ -137,7 +347,12 @@ class MeshShardedDecoder:
         for s in slots:
             if s.state is None:
                 body = s.request or {}
-                prompt = int(body.get("prompt", 0)) % self._vocab
+                prompt = body.get("prompt", 0)
+                if isinstance(prompt, (list, tuple)):
+                    # Token-list form: dense decode continues from the
+                    # LAST prompt token (reference_decode semantics).
+                    prompt = prompt[-1] if prompt else 0
+                prompt = int(prompt) % self._vocab
                 s.state = {"row": None, "out": [],
                            "need": max(1, int(body.get("tokens", 1))),
                            "prompt": prompt}
@@ -165,11 +380,15 @@ class MeshShardedDecoder:
         return self._decode(body)
 
     # -- host-side reference (tests pin numerics against this) -------------
-    def reference_decode(self, prompt: int, tokens: int) -> List[int]:
+    def reference_decode(self, prompt, tokens: int) -> List[int]:
         """Plain sequential greedy decode on the host — exact-integer
-        arithmetic makes it bitwise comparable to the device chain."""
+        arithmetic makes it bitwise comparable to the device chain.
+        ``prompt`` may be an id or a token list (decode continues from
+        the LAST prompt token, matching the paged prefill semantics)."""
         np = self._np
-        x = self._emb_host[prompt % self._vocab]
+        if isinstance(prompt, (list, tuple)):
+            prompt = prompt[-1] if prompt else 0
+        x = self._emb_host[int(prompt) % self._vocab]
         out = []
         for _ in range(tokens):
             t = int(np.argmax(x @ self._w_host))

@@ -602,3 +602,51 @@ def test_serve_batcher_locks_are_leaves(checker):
             f"a lock was acquired while holding a serve batcher lock: "
             f"{edges.get(site)}")
     checker.assert_acyclic()
+
+
+def test_paged_batcher_lock_stays_leaf_with_kv_engine(checker):
+    """Paged-KV admission convention (serve/kv_cache.py): the engine
+    adopts the batcher's LEAF lock via bind() — block-availability
+    re-checks at admission, retire-time frees, step-side write planning,
+    and a mid-flight stats snapshot all run under the ONE batcher lock,
+    with caller events still set outside it.  Driven through allocator
+    exhaustion (parks + re-admission) the acquisition graph must show
+    zero outgoing edges from the batcher lock."""
+    from ray_tpu.serve.continuous import _ContinuousBatcher
+    from ray_tpu.serve.kv_cache import PagedKVEngine
+
+    eng = PagedKVEngine(4, 4, tokens_for=lambda r: ((), r),
+                        prefix_caching=False)
+
+    def stepfn(slots):
+        time.sleep(0.001)
+        for s in slots:
+            s.state = (s.state or 0) + 1
+            # Step-side engine paths acquire the SAME (leaf) guard.
+            eng.plan_writes(s, s.state - 1, 1)
+            eng.note_tokens(1)
+            if s.state >= s.request:
+                s.finish(s.state)
+
+    b = _ContinuousBatcher(stepfn, None, 8, 0.0, continuous=True, kv=eng)
+    assert isinstance(b._lock, lockcheck._LockProxy)
+    assert eng._guard is b._lock   # bind() adopted the batcher leaf
+    results = []
+    # 16-token pool, 8-token budgets: >2 concurrent submits exhaust the
+    # pool so the run exercises park -> retire -> re-admit boundaries.
+    threads = [threading.Thread(target=lambda n=n:
+                                results.append(b.submit(n)))
+               for n in (8, 8, 8, 8, 8, 8)]
+    for t in threads:
+        t.start()
+    b.stats()                      # concurrent snapshot mid-flight
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 6
+    s = b.stats()
+    assert s["admission_parks"] >= 1 and s["kv_blocks_used"] == 0
+    edges = checker.edges()
+    assert edges.get(b._lock._site, set()) == set(), (
+        f"a lock was acquired while holding the paged batcher leaf "
+        f"lock: {edges.get(b._lock._site)}")
+    checker.assert_acyclic()

@@ -171,6 +171,31 @@ def test_seeded_mutations_each_produce_the_expected_finding(tmp_path):
     with open(path, "w", encoding="utf-8") as f:
         f.write(orig)
 
+    # 5. Remove a serving-memory knob from _worker_config_env -> RTL504:
+    #    the paged_kv switch is read in REPLICA workers and would
+    #    silently stop following _system_config.
+    path, orig = _mutate(
+        pkg, "_private/runtime.py",
+        '            "RAY_TPU_PAGED_KV":\n'
+        '                "1" if self.config.paged_kv else "0",\n',
+        '')
+    findings = run()
+    assert any(f.rule == "RTL504" and "paged_kv" in f.message
+               for f in findings), findings
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(orig)
+
+    # 6. Drop a serving-memory counter from the controller rollup ->
+    #    RTL504 anchored at the batcher/engine stats dict that ships it
+    #    (the serve-plane twin of the xfer-stats survival rule).
+    path, orig = _mutate(
+        pkg, "serve/api.py", '"prefix_hits",', '')
+    findings = run()
+    assert any(f.rule == "RTL504" and "prefix_hits" in f.message
+               and "rollup" in f.message for f in findings), findings
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(orig)
+
     assert run() == [], "restores must return the copy to clean"
 
 
