@@ -269,7 +269,8 @@ def client_connect(address: str, authkey: bytes,
         raise ConnectionError(f"cannot reach cluster at {address}: {err}")
     os.environ.setdefault("RAY_TPU_AUTHKEY", authkey.hex())
     shm = ShmStore(shm_dir=tempfile.mkdtemp(prefix="ray_tpu_client_"))
-    rt = ClientRuntime(conn, threading.Lock(), shm, max_inline)
+    send_lock = threading.Lock()  # lock-order: io-guard
+    rt = ClientRuntime(conn, send_lock, shm, max_inline)
     rt._address = address
     rt._authkey = authkey
     # The puller dials remote object servers (including the head's own —

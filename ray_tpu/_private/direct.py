@@ -110,7 +110,7 @@ class _Lease:
         self.worker_id = worker_id
         self.addr = addr
         self.conn = None
-        self.send_lock = threading.Lock()
+        self.send_lock = threading.Lock()  # lock-order: io-guard
         self.inflight: Dict[int, dict] = {}  # rid -> entry
         self.funcs_sent: set = set()
         self.dead = False
@@ -134,7 +134,7 @@ class _Lease:
         # never block on an in-flight write, which is what lets batches
         # self-clock with no added latency floor.
         self.outbuf: List[tuple] = []
-        self.buf_lock = threading.Lock()
+        self.buf_lock = threading.Lock()  # lock-order: leaf
         # Channel-liveness state (failure detection): last_recv is
         # stamped by the reader on EVERY message; the watchdog probes a
         # channel with in-flight pushes and no traffic for
@@ -1947,7 +1947,7 @@ class _DirectSource:
 
     def __init__(self, conn, queue_empty=None):
         self.conn = conn
-        self.send_lock = threading.Lock()
+        self.send_lock = threading.Lock()  # lock-order: io-guard
         self.pending: List[tuple] = []
         self._queue_empty = queue_empty or (lambda: True)
         self._queued = 0  # THIS caller's tasks still unanswered

@@ -77,7 +77,7 @@ class NodeAgent:
         # acks registration (the object server may get connections first).
         self.store = ShmStore(shm_dir=shm_dir)
         self.conn = None
-        self.send_lock = threading.Lock()
+        self.send_lock = threading.Lock()  # lock-order: io-guard
         self.workers: Dict[str, subprocess.Popen] = {}
         self.session = ""
         # Set once the head's agent_ack has been processed.  The memory

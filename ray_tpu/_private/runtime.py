@@ -197,7 +197,7 @@ class WorkerHandle:
         self.conn = conn  # None until the worker dials back (accept thread)
         self.proc = proc  # subprocess.Popen
         self.node = node
-        self.send_lock = threading.Lock()
+        self.send_lock = threading.Lock()  # lock-order: io-guard
         self.env_key = env_key
         # Tasks pushed to this worker and not yet resulted, in send order
         # (the worker executes its queue FIFO).  Reference: task pipelining
@@ -292,7 +292,7 @@ class WorkerHandle:
         with self.send_lock:
             self.conn = conn
             for msg in self.outbox:
-                protocol.send(conn, msg)
+                protocol.send(conn, msg)  # noqa: RTL604 -- re-register attaches under the lock by design: the ack must beat any locked send onto this conn; outbox is bounded by the blip window
             self.outbox.clear()
 
 
@@ -305,7 +305,7 @@ class AgentHandle:
         self.store_id = store_id
         self.shm_dir = shm_dir
         self.info = info
-        self.send_lock = threading.Lock()
+        self.send_lock = threading.Lock()  # lock-order: io-guard
         self.node: Optional["NodeState"] = None
         self.dead = False
         self._rid = 0
@@ -761,6 +761,7 @@ class Runtime:
         # need) the big runtime lock just to mark a worker dirty.
         self._sender_event = threading.Event()
         self._dirty_workers: set = set()
+        self._dirty_agent_msgs: List[tuple] = []
         self._dirty_lock = threading.Lock()
         # Client lease requests waiting for capacity (reference: the
         # raylet's queued RequestWorkerLease); serviced by _dispatch_locked
@@ -810,7 +811,7 @@ class Runtime:
         # the same pid-keyed tmp file — concurrent writers would tear
         # it, and a stale periodic os.replace landing AFTER the clean
         # one would un-mark the shutdown).
-        self._gcs_write_lock = threading.Lock()
+        self._gcs_write_lock = threading.Lock()  # lock-order: io-guard
         # Object-row cache for huge tables (see _snapshot_gcs).
         self._snap_obj_cache = None
         if self._restore_data is not None:
@@ -828,15 +829,33 @@ class Runtime:
             self._sender_event.clear()
             with self._dirty_lock:
                 dirty, self._dirty_workers = self._dirty_workers, set()
+                agent_msgs, self._dirty_agent_msgs = (
+                    self._dirty_agent_msgs, [])
             for w in dirty:
                 try:
                     w.flush_buffered()
                 except Exception:
                     self._on_worker_death(w)
+            for agent, msg in agent_msgs:
+                if agent.dead:
+                    continue
+                try:
+                    agent.send(msg)
+                except Exception:
+                    pass  # best-effort, same as the old inline send
 
     def _mark_dirty(self, worker: "WorkerHandle"):
         with self._dirty_lock:
             self._dirty_workers.add(worker)
+        self._sender_event.set()
+
+    def _queue_agent_send(self, agent: "AgentHandle", msg: tuple):
+        """Fire-and-forget agent control frame (segment unlinks),
+        deferred to the sender thread: the free path runs under the
+        runtime lock, and a blocking send there stalls every other
+        acquirer on one slow agent conn (lockgraph RTL604)."""
+        with self._dirty_lock:
+            self._dirty_agent_msgs.append((agent, msg))
         self._sender_event.set()
 
     # Sentinel marking "every shard needs a pass" (resources freed).
@@ -1309,11 +1328,9 @@ class Runtime:
                     # paths).
                     agent = self._agents.get(home)
                     if agent is not None and not agent.dead:
-                        try:
-                            agent.send(("unlink_segment", st.descr[1],
-                                        st.descr[2]))
-                        except Exception:
-                            pass
+                        self._queue_agent_send(
+                            agent, ("unlink_segment", st.descr[1],
+                                    st.descr[2]))
             if st.descr is not None and st.descr[0] == protocol.SHM:
                 home = st.descr[3] if len(st.descr) > 3 else self.store_id
                 cw = st.creator
@@ -1336,7 +1353,8 @@ class Runtime:
                     else:
                         agent = self._agents.get(home)
                         if agent is not None and not agent.dead:
-                            agent.send(("unlink_segment", st.descr[1],
+                            self._queue_agent_send(
+                                agent, ("unlink_segment", st.descr[1],
                                         st.descr[2]))
             if st.segment is not None:
                 st.segment.close()
@@ -2804,7 +2822,7 @@ class Runtime:
         w = WorkerHandle(worker_id, None, None, node, env_key, tpu_chips)
         node.all_workers[id(w)] = w
         self._pending_workers[worker_id.hex()] = w
-        node.agent.send(("spawn_worker", worker_id.hex(), overrides))
+        node.agent.send(("spawn_worker", worker_id.hex(), overrides))  # noqa: RTL604 -- spawn is a rare, already process-fork-slow path; one small control frame
         return w
 
     def _object_server_loop(self):
@@ -2894,14 +2912,23 @@ class Runtime:
                 # Spawned by this head: same build, speaks the lease
                 # plane (unsolicited grants included).
                 w.lease_caps = True
-                w.attach(conn)
-                w.ready.set()
                 # First suspicion deadline gets the initial-delay slack
                 # (boot/env/JIT warmup legitimately delay heartbeats).
                 w.last_seen = (time.monotonic()
                                + self.config.health_check_initial_delay_s)
                 self._conn_to_worker[conn] = w
                 self._workers_by_hex[worker_id_hex] = w
+            # Attach OUTSIDE the runtime lock: the outbox flush is a
+            # blocking socket write, and holding the big lock across it
+            # stalled every other acquirer on one slow worker conn
+            # (found by lockgraph RTL604).  Sends racing the attach just
+            # park in the outbox under send_lock — order is preserved.
+            try:
+                w.attach(conn)
+            except Exception:
+                self._on_worker_death(w)
+                continue
+            w.ready.set()
             # One reader thread per connection (replaces the old select
             # loop): recv/unpickle for different workers runs in parallel,
             # and a burst from one worker is drained back-to-back instead
@@ -3513,7 +3540,7 @@ class Runtime:
         for d in list(args) + list(kwargs.values()):
             if d is not None and d[0] == protocol.ERROR:
                 self._fail_task_locked(
-                    rec, serialization.loads_inline(d[1]), dispatchable=False)
+                    rec, serialization.loads_inline(d[1]), dispatchable=False)  # noqa: RTL604 -- inline ERROR payloads are bounded-small; no socket IO
                 return False
         msg_task = {
             "task_id": spec["task_id"],
@@ -3572,7 +3599,7 @@ class Runtime:
     def _fail_task_locked(self, rec: TaskRecord, error: BaseException,
                           dispatchable=True):
         spec = rec.spec
-        payload = serialization.dumps_inline(error)
+        payload = serialization.dumps_inline(error)  # noqa: RTL604 -- task-failure path; error payloads are bounded-small
         tid = TaskID(spec["task_id"])
         for i in range(max(1, spec["num_returns"])):
             self._complete_object_locked(
@@ -5231,11 +5258,11 @@ class Runtime:
             for b in id_bins:
                 st = self.objects.get(ObjectID(b))
                 if st is None:
-                    err = serialization.dumps_inline(exc.ObjectFreedError(
+                    err = serialization.dumps_inline(exc.ObjectFreedError(  # noqa: RTL604 -- bounded-small error payload on the miss path
                         object_id=b.hex(), owner="driver", phase="get"))
                     out.append((False, (protocol.ERROR, err)))
                 elif st.status == PENDING:
-                    err = serialization.dumps_inline(exc.GetTimeoutError(
+                    err = serialization.dumps_inline(exc.GetTimeoutError(  # noqa: RTL604 -- bounded-small error payload on the timeout path
                         f"Timed out getting {b.hex()} after {timeout}s"))
                     out.append((False, (protocol.ERROR, err)))
                 else:
@@ -5459,7 +5486,7 @@ class Runtime:
                     pass
             elif not agent.dead:
                 try:
-                    agent.send(("unlink_segment", name, size))
+                    agent.send(("unlink_segment", name, size))  # noqa: RTL604 -- worker-death path; final best-effort reroute of its buffered frees
                 except Exception:
                     pass
 
@@ -5477,7 +5504,7 @@ class Runtime:
             pass
         self._reroute_dead_worker_frees_locked(worker)
         try:
-            worker.send(("kill",))
+            worker.send(("kill",))  # noqa: RTL604 -- death path: kill must be ordered after the final flush on this conn
         except Exception:
             pass
         try:
@@ -5689,7 +5716,7 @@ class Runtime:
             agent = self._agents.get(home)
             if agent is not None and not agent.dead:
                 try:
-                    agent.send(("unlink_segment", descr[1], descr[2]))
+                    agent.send(("unlink_segment", descr[1], descr[2]))  # noqa: RTL604 -- checkpoint GC is rare; one small control frame per freed ckpt
                 except Exception:
                     pass
 

@@ -52,7 +52,7 @@ class _WorkerRuntime:
 
     def __init__(self, conn, send_lock, shm: ShmStore, max_inline: int):
         self.conn = conn
-        self.send_lock = send_lock
+        self.send_lock = send_lock  # lock-order: io-guard
         self.shm = shm
         self.max_inline = max_inline
         self.req_counter = itertools.count(1)
@@ -166,6 +166,7 @@ class _WorkerRuntime:
             "RAY_TPU_HEAD_RECONNECT_GRACE_S", "20") or 0)
         self._conn_down = False
         self._head_outbox: list = []
+        # lock-order: io-guard -- serializes re-dial+handshake+replay IO
         self._reconn_lock = threading.Lock()
         self._shutting_down = False
         self.head_reconnects = 0
@@ -1749,7 +1750,7 @@ def worker_entry(conn, worker_id_hex: str, session: str, shm_dir: str,
 
         chaos_mod.maybe_arm_env_net_chaos("worker")
     global _runtime
-    send_lock = threading.Lock()
+    send_lock = threading.Lock()  # lock-order: io-guard
     # Workers pool freed segments too (the driver routes "free_segment" back
     # to the creating worker) — without this, every worker-side put writes
     # fresh tmpfs pages at fault+zero speed instead of memcpy speed.

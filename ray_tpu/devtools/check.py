@@ -1,0 +1,73 @@
+"""One entry point for every static analyzer in the repo.
+
+    python -m ray_tpu.devtools.check [PATH ...]
+
+Runs, in order, over the same path set:
+
+  1. ``lint``       — per-file pattern rules (RTL0xx-RTL4xx)
+  2. ``protocheck`` — whole-program wire-protocol conformance (RTL5xx)
+  3. ``lockgraph``  — whole-program static lock-graph rules (RTL6xx)
+
+and exits with the MERGED status: 0 only when all three sweep clean,
+1 when any analyzer produced findings, 2 on usage errors.  With no
+paths, defaults to ``ray_tpu/`` and ``tests/`` — the exact invocation
+the tier-1 clean-tree gates (test_lint_clean.py,
+test_lockgraph_clean.py) keep green.
+
+Per-analyzer flags (``--select``, ``--doc``, ``--dump``) live on the
+individual CLIs; this runner takes only paths.
+"""
+
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from ray_tpu.devtools import lint, lockgraph, protocheck
+from ray_tpu.devtools.lint import Finding
+
+_USAGE = "usage: python -m ray_tpu.devtools.check [PATH ...]"
+
+
+def _default_paths() -> List[str]:
+    import ray_tpu
+
+    pkg = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+    tests = os.path.join(os.path.dirname(pkg), "tests")
+    return [pkg] + ([tests] if os.path.isdir(tests) else [])
+
+
+def check_paths(paths) -> List[Tuple[str, Finding]]:
+    """(analyzer name, finding) for every un-suppressed finding from
+    every analyzer, in analyzer order then location order."""
+    out: List[Tuple[str, Finding]] = []
+    out.extend(("lint", f) for f in lint.lint_paths(paths))
+    out.extend(("protocheck", f) for f in protocheck.check_paths(paths))
+    out.extend(("lockgraph", f) for f in lockgraph.check_paths(paths))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if any(a.startswith("-") for a in argv):
+        print(_USAGE, file=sys.stderr)
+        return 2
+    paths = argv or _default_paths()
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"error: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    findings = check_paths(paths)
+    for name, f in findings:
+        print(f"[{name}] {f!r}")
+    if findings:
+        print(f"{len(findings)} finding(s) across "
+              f"{len({name for name, _ in findings})} analyzer(s). "
+              f"Suppress deliberate patterns with "
+              f"'# noqa: <RULE-ID> -- reason'.", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,10 +115,15 @@ RULES: Dict[str, str] = {
               "core can hang forever on a stalled peer",
 }
 
-# RTL402: the runtime/table locks the rule guards (deliberately NOT
-# send_lock/buf_lock — those exist to guard a socket write and holding
-# them across the send is the design).
-_RUNTIME_LOCK_RE = re.compile(r"^_?lock$")
+# RTL402: any lock-named with-target is a runtime/table lock the rule
+# guards.  Locks that exist to guard a socket write (send_lock and
+# friends — holding them across the send is the design) opt out with a
+# structured `# lock-order: io-guard` annotation at the creation or
+# binding site; lockgraph.py reads the same grammar, so the lexical and
+# interprocedural checkers cannot disagree about which locks are exempt.
+_RUNTIME_LOCK_RE = re.compile(r"(^|_)lock$")
+_IO_GUARD_RE = re.compile(r"#\s*lock-order:\s*io-guard\b")
+_LOCK_BIND_RE = re.compile(r"([A-Za-z_]\w*)\s*=")
 # Receivers whose .send()/.recv() is a blocking socket call in this
 # codebase (connection objects and the head-side peer handles).
 _SOCKISH_RE = re.compile(r"conn|sock|agent|worker|lessee|peer|client")
@@ -207,8 +212,12 @@ class _Frame:
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, tree: ast.Module,
-                 table: Optional[symtable.SymbolTable]):
+                 table: Optional[symtable.SymbolTable],
+                 io_guard: Optional[Set[str]] = None):
         self.path = path
+        # Lock names annotated `# lock-order: io-guard` in this file:
+        # exempt from RTL402 (they exist to be held across the write).
+        self._io_guard: Set[str] = io_guard or set()
         self.findings: List[Finding] = []
         self.frames: List[_Frame] = [_Frame("module", "<module>")]
         # symtable function blocks keyed by (name, first line) so free
@@ -360,7 +369,8 @@ class _Linter(ast.NodeVisitor):
     def _holds_runtime_lock(self, node) -> bool:
         for item in node.items:
             chain = _attr_chain(item.context_expr)
-            if chain and _RUNTIME_LOCK_RE.match(chain[-1]):
+            if chain and _RUNTIME_LOCK_RE.search(chain[-1]) \
+                    and chain[-1] not in self._io_guard:
                 return True
         return False
 
@@ -509,6 +519,23 @@ class _Linter(ast.NodeVisitor):
             f"acquirer — use 'with {leaf}:'")
 
 
+def _io_guard_names(source: str) -> Set[str]:
+    """Lock names annotated ``# lock-order: io-guard`` anywhere in the
+    file — at the creation or forwarded-binding line, or on an
+    annotation-only line directly above it (lockgraph's grammar)."""
+    out: Set[str] = set()
+    lines = source.splitlines()
+    for i, line in enumerate(lines):
+        if not _IO_GUARD_RE.search(line):
+            continue
+        bind = line if "=" in line.split("#", 1)[0] else (
+            lines[i + 1] if i + 1 < len(lines) else "")
+        for name in _LOCK_BIND_RE.findall(bind.split("#", 1)[0]):
+            if _RUNTIME_LOCK_RE.search(name):
+                out.add(name)
+    return out
+
+
 def _noqa_rules(line: str) -> Set[str]:
     match = _NOQA_RE.search(line)
     if not match:
@@ -530,7 +557,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
         table = symtable.symtable(source, path, "exec")
     except SyntaxError:
         table = None
-    linter = _Linter(path, tree, table)
+    linter = _Linter(path, tree, table, _io_guard_names(source))
     linter.visit(tree)
     lines = source.splitlines()
     kept = []

@@ -351,6 +351,56 @@ def violations() -> List[str]:
         return list(_state.violations)
 
 
+_leaf_registry_cache: Optional[Dict[str, str]] = None
+
+
+def leaf_registry(refresh: bool = False) -> Dict[str, str]:
+    """``realpath:line -> lock name`` for every ``# lock-order: leaf``
+    creation site, straight from the static analyzer (lockgraph is the
+    one source of truth; this module keeps no leaf list of its own, so
+    the static and dynamic checkers cannot disagree).  Cached: the
+    static parse is ~seconds and this is debug tooling."""
+    global _leaf_registry_cache
+    if _leaf_registry_cache is None or refresh:
+        from ray_tpu.devtools import lockgraph
+
+        _leaf_registry_cache = lockgraph.leaf_sites()
+    return _leaf_registry_cache
+
+
+def leaf_violations() -> List[str]:
+    """Observed runtime edges that LEAVE an annotated leaf lock — the
+    dynamic counterpart of lockgraph RTL602.  Computed on demand (not in
+    the acquire path) so recording stays cheap."""
+    registry = leaf_registry()
+    out = []
+    for frm, tos in edges().items():
+        name = registry.get(frm)
+        if name is None:
+            continue
+        for to in sorted(tos):
+            if to != frm:
+                out.append(f"leaf lock '{name}' ({frm}) acquired {to} "
+                           "while held — an annotated leaf must nest "
+                           "nothing")
+    return out
+
+
+def export_graph() -> dict:
+    """JSON-serializable dump of everything a cross-checking test
+    needs: the observed acquisition edges, cycle + leaf violations, and
+    the (static) leaf registry this checker consumes.  The lockgraph
+    superset test asserts every observed edge between statically-known
+    creation sites appears in the static graph."""
+    return {
+        "edges": sorted([frm, to] for frm, tos in edges().items()
+                        for to in tos),
+        "violations": violations(),
+        "leaf_violations": leaf_violations(),
+        "leaf_registry": dict(leaf_registry()),
+    }
+
+
 def stalls() -> List[str]:
     if _state is None:
         return []

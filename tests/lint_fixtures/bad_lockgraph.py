@@ -1,0 +1,86 @@
+# protocheck: role=worker
+"""RTL6xx bad fixture: a two-lock cycle split across call paths (only
+the whole-program graph sees it close), a declared leaf that grew an
+outgoing edge through a call, an Event.set reached inside a leaf body,
+blocking pickling buried two calls deep under a runtime lock (lexical
+RTL402's exact blind spot), and a reasonless RTL6xx suppression.
+
+protocheck's one-level RTL505 fires alongside on the lock-under-lock
+call sites — the markers pin the layering: RTL505 is the one-hop
+lexical inference, RTL60x the transitive whole-program verdicts."""
+
+import pickle
+import threading
+
+
+class Cycle:
+    def __init__(self):
+        self.fwd_lock = threading.Lock()
+        self.rev_lock = threading.Lock()
+
+    def fwd(self):
+        with self.fwd_lock:
+            self._grab_rev()  # EXPECT: RTL505  # EXPECT: RTL601
+
+    def _grab_rev(self):
+        with self.rev_lock:
+            pass
+
+    def rev(self):
+        with self.rev_lock:
+            self._grab_fwd()  # EXPECT: RTL505
+
+    def _grab_fwd(self):
+        with self.fwd_lock:
+            pass
+
+
+class LeafGrowth:
+    def __init__(self):
+        self._stats_lock = threading.Lock()  # lock-order: leaf
+        self._table_lock = threading.Lock()
+        self._ready = threading.Event()
+
+    def bump(self):
+        with self._stats_lock:
+            self._reindex()  # EXPECT: RTL505  # EXPECT: RTL602
+
+    def _reindex(self):
+        with self._table_lock:
+            pass
+
+    def publish(self):
+        with self._stats_lock:
+            self._wake()  # EXPECT: RTL603
+
+    def _wake(self):
+        self._ready.set()
+
+
+class Frozen:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+    def snapshot(self, table):
+        with self.lock:
+            return self._encode(table)
+
+    def _encode(self, table):
+        return self._really_encode(table)
+
+    def _really_encode(self, table):
+        return pickle.dumps(table)  # EXPECT: RTL604
+
+
+class Sloppy:
+    def __init__(self):
+        self._q_lock = threading.Lock()  # lock-order: leaf
+        self._aux_lock = threading.Lock()
+
+    def drain(self):
+        with self._q_lock:
+            self._flush()  # noqa: RTL602  # EXPECT: RTL505  # EXPECT: RTL600
+
+    def _flush(self):
+        with self._aux_lock:
+            pass

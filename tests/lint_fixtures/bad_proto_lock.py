@@ -15,7 +15,7 @@ class PutRegistry:
 
     def write(self, name):
         with self._lock:
-            self._teardown(name)  # EXPECT: RTL505
+            self._teardown(name)  # EXPECT: RTL505  # EXPECT: RTL602
             return True
 
     def _teardown(self, name):

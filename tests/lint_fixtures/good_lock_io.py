@@ -11,7 +11,7 @@ from ray_tpu._private import protocol, serialization
 class Head:
     def __init__(self, conn):
         self.lock = threading.RLock()
-        self.send_lock = threading.Lock()
+        self.send_lock = threading.Lock()  # lock-order: io-guard
         self.conn = conn
         self.table = {}
 
@@ -27,8 +27,9 @@ class Head:
         return serialization.dumps_inline(rid)
 
     def send_under_send_lock(self, msg):
-        # A send lock guards exactly this socket write: holding it across
-        # the send IS the design (it is not a table lock).
+        # An io-guard lock guards exactly this socket write: holding it
+        # across the send IS the design (declared at the creation site
+        # with '# lock-order: io-guard'; shared with lockgraph).
         with self.send_lock:
             protocol.send(self.conn, msg)
 

@@ -1,0 +1,63 @@
+# protocheck: role=worker
+"""Good twin of bad_lockgraph.py: the same shapes done right — one
+global acquisition order into a declared leaf, the event signaled after
+the leaf releases, the pickle hoisted outside the critical section, and
+an io-guard lock whose held-across-the-write is the declared design.
+All three analyzers (lint, protocheck, lockgraph) must stay silent."""
+
+import pickle
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self.outer_lock = threading.Lock()
+        self.inner_lock = threading.Lock()  # lock-order: leaf
+
+    def fwd(self):
+        # Every path nests outer -> inner; nesting INTO a leaf is the
+        # convention (the leaf itself acquires nothing).
+        with self.outer_lock:
+            self._grab_inner()
+
+    def _grab_inner(self):
+        with self.inner_lock:
+            pass
+
+
+class Signals:
+    def __init__(self):
+        self._stats_lock = threading.Lock()  # lock-order: leaf
+        self._ready = threading.Event()
+
+    def publish(self):
+        with self._stats_lock:
+            count = 1
+        # Signal AFTER the leaf releases: a woken waiter that re-enters
+        # this class never finds the leaf still held.
+        self._ready.set()
+        return count
+
+
+class Thawed:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+    def snapshot(self, table):
+        with self.lock:
+            rows = list(table)
+        # The serialize runs outside the critical section — other
+        # acquirers never stall behind the pickle.
+        return pickle.dumps(rows)
+
+
+class Wire:
+    def __init__(self, conn):
+        self.conn = conn
+        self.send_lock = threading.Lock()  # lock-order: io-guard
+
+    def send(self, payload):
+        # Holding an io-guard lock across its socket write IS the
+        # design; the annotation is the shared lint/lockgraph opt-out.
+        with self.send_lock:
+            self.conn.send_bytes(payload)

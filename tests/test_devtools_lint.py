@@ -1,18 +1,19 @@
 """Framework linter tests: every rule's good/bad fixture pair, exact rule
 IDs and line numbers, suppression syntax, and the CLI contract.
 
-The EXPECT harness covers BOTH analyzers: per-file lint findings plus
+The EXPECT harness covers ALL THREE analyzers: per-file lint findings,
 whole-program protocheck findings (a proto fixture names its companion
 modules with `# protocheck-with: other.py`, so the two-module cases —
 sender/handler arity drift, knob plumbing — analyze as one program with
-findings attributed per file)."""
+findings attributed per file), and lockgraph's interprocedural RTL6xx
+verdicts over the same file set."""
 
 import os
 import re
 import subprocess
 import sys
 
-from ray_tpu.devtools import lint, protocheck
+from ray_tpu.devtools import lint, lockgraph, protocheck
 
 FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 _EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z0-9]+)")
@@ -43,10 +44,15 @@ def _companions(path):
 
 
 def _fixture_findings(path):
-    """{(line, rule)} from both analyzers, attributed to this file."""
+    """{(line, rule)} from all three analyzers, attributed to this
+    file."""
+    companions = _companions(path)
     got = {(f.line, f.rule) for f in lint.lint_file(path)}
     got |= {(f.line, f.rule)
-            for f in protocheck.check_paths([path] + _companions(path))
+            for f in protocheck.check_paths([path] + companions)
+            if f.path == path}
+    got |= {(f.line, f.rule)
+            for f in lockgraph.check_paths([path] + companions)
             if f.path == path}
     return got
 
@@ -69,7 +75,8 @@ def test_every_rule_has_a_firing_fixture():
     covered = set()
     for path in _fixture_files():
         covered.update(rule for _, rule in _expected_findings(path))
-    all_rules = set(lint.RULES) | set(protocheck.RULES)
+    all_rules = (set(lint.RULES) | set(protocheck.RULES)
+                 | set(lockgraph.RULES))
     assert covered == all_rules, (
         f"rules without a bad fixture: {all_rules - covered}")
 

@@ -2,13 +2,14 @@
 framework-lint findings AND zero un-suppressed protocheck findings, so a
 regression fails plain `pytest tests/` without a separate CI job (the
 `python -m ray_tpu.devtools.lint` / `...protocheck` CLIs are the same
-engines)."""
+engines; `python -m ray_tpu.devtools.check` runs all three analyzers —
+lockgraph's gate lives in test_lockgraph_clean.py)."""
 
 import os
 import time
 
 import ray_tpu
-from ray_tpu.devtools import lint, protocheck
+from ray_tpu.devtools import check, lint, protocheck
 
 PKG_DIR = os.path.dirname(os.path.abspath(ray_tpu.__file__))
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -50,3 +51,13 @@ def test_tree_is_protocheck_clean_within_budget():
     assert elapsed < 10.0, (
         f"protocheck took {elapsed:.1f}s over ray_tpu/ + tests/ — the "
         f"tier-1 gate budget is 10s")
+
+
+def test_merged_check_entry_point_is_clean():
+    """The one-stop `python -m ray_tpu.devtools.check` gate: all three
+    analyzers over its default path set (ray_tpu/ + tests/) merge to a
+    clean exit — the exact command CI and pre-push hooks run."""
+    findings = check.check_paths([PKG_DIR, TESTS_DIR])
+    assert findings == [], "\n".join(
+        f"[{name}] {f!r}" for name, f in findings)
+    assert check.main([PKG_DIR, TESTS_DIR]) == 0

@@ -1,0 +1,348 @@
+"""Whole-program lock-graph analyzer tests: the seeded-mutation battery
+(each concurrency-contract-breaking edit to a COPY of the real tree
+produces exactly the expected RTL6xx finding), the static-superset
+cross-check against the runtime lockcheck's observed edges, the shared
+leaf registry, and the CLI contract.
+
+The fixture-level EXPECT coverage for RTL600-604 lives in
+test_devtools_lint.py (the shared harness); this file owns the
+whole-tree properties."""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+from ray_tpu.devtools import lockcheck, lockgraph
+
+PKG_DIR = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+
+
+# -- registry agreement -----------------------------------------------------
+
+def test_readme_lock_order_table_matches_generated_doc():
+    """The README's LOCK ORDER table must equal `lockgraph --doc`
+    byte-for-byte (regenerate with
+    `python -m ray_tpu.devtools.lockgraph --doc` after changing any
+    lock creation site or annotation) — the same no-drift contract the
+    wire-protocol verb table carries."""
+    readme = os.path.join(os.path.dirname(PKG_DIR), "README.md")
+    with open(readme, "r", encoding="utf-8") as f:
+        content = f.read()
+    assert lockgraph.lock_order_doc() in content, (
+        "README.md's LOCK ORDER table is stale — regenerate it with "
+        "`python -m ray_tpu.devtools.lockgraph --doc`")
+
+
+def test_leaf_registry_is_shared_with_runtime_lockcheck():
+    """lockcheck consumes lockgraph's leaf sites verbatim — one source
+    of truth, so the static and dynamic checkers cannot disagree about
+    which locks are leaves."""
+    static = lockgraph.leaf_sites()
+    assert static, "tree has annotated leaves"
+    assert lockcheck.leaf_registry(refresh=True) == static
+    for site, name in static.items():
+        path, line = site.rsplit(":", 1)
+        assert os.path.isabs(path) and int(line) > 0, site
+        # Every registered site line really carries the annotation.
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+        window = "".join(lines[max(0, int(line) - 2):int(line)])
+        assert "lock-order: leaf" in window, (site, name)
+
+
+def test_leaf_violations_use_the_static_registry(monkeypatch):
+    """The dynamic leaf check flags an observed edge LEAVING a
+    registered leaf site (the runtime counterpart of RTL602)."""
+    lockcheck.install(raise_on_cycle=False)
+    try:
+        lockcheck.clear()
+        import threading
+        leaf = threading.Lock()
+        other = threading.Lock()
+        with leaf:
+            with other:
+                pass
+        # Register the leaf's creation site as if it were annotated.
+        (leaf_site,) = [frm for frm in lockcheck.edges()]
+        monkeypatch.setattr(lockcheck, "_leaf_registry_cache",
+                            {leaf_site: "test._leaf"})
+        bad = lockcheck.leaf_violations()
+        assert len(bad) == 1 and "test._leaf" in bad[0], bad
+        exported = lockcheck.export_graph()
+        assert exported["leaf_violations"] == bad
+        assert [leaf_site, sorted(lockcheck.edges()[leaf_site])[0]] \
+            in exported["edges"]
+    finally:
+        lockcheck.uninstall()
+
+
+# -- static superset of observed runtime edges ------------------------------
+
+def test_static_graph_is_superset_of_runtime_observed_edges():
+    """Soundness cross-check: every lock-nesting edge the runtime
+    lockcheck observes during a real init/task/actor/put workload —
+    between creation sites the static analyzer knows — must already be
+    in the static graph.  A missing edge means lockgraph's call-graph
+    resolution lost a path the scheduler actually executed."""
+    code = textwrap.dedent("""
+        import json
+        import ray_tpu
+        from ray_tpu.devtools import lockcheck
+        assert lockcheck.enabled()
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(4)]) == [1, 2, 3, 4]
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get([c.inc.remote() for _ in range(3)]) == [1, 2, 3]
+        ref = ray_tpu.put(list(range(50000)))
+        assert len(ray_tpu.get(ref)) == 50000
+        ray_tpu.shutdown()
+        print("EDGES_JSON=" + json.dumps(lockcheck.export_graph()["edges"]))
+    """)
+    env = dict(os.environ, RAY_TPU_LOCKCHECK="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    match = re.search(r"EDGES_JSON=(\[.*\])", proc.stdout)
+    assert match, proc.stdout[-2000:]
+    observed = [tuple(e) for e in json.loads(match.group(1))]
+    assert observed, "workload recorded no lock nestings at all"
+
+    analysis = lockgraph.Analysis([PKG_DIR])
+    known = set(analysis.known_sites())
+    static_edges = analysis.site_edges()
+    # Only edges between sites the static analyzer models are in scope:
+    # Event/Queue-internal locks attribute to ray_tpu lines but are not
+    # lock creation sites, and self-edges (two instances of one class)
+    # are the runtime checker's own ABBA domain.
+    in_scope = [(frm, to) for frm, to in observed
+                if frm in known and to in known and frm != to]
+    assert in_scope, (
+        "no observed edge mapped to known static sites — the site "
+        f"vocabularies diverged: observed={observed[:10]}")
+    missing = [e for e in in_scope if e not in static_edges]
+    assert not missing, (
+        "runtime lockcheck observed lock-nesting edges the static "
+        f"graph lacks (analyzer unsoundness): {missing}")
+
+
+# -- seeded mutations -------------------------------------------------------
+
+def _mutate(pkg: str, rel: str, old: str, new: str):
+    path = os.path.join(pkg, rel)
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    assert old in src, f"mutation anchor vanished from {rel}: {old!r}"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src.replace(old, new, 1))
+    return path, src
+
+
+def test_seeded_mutations_each_produce_the_expected_finding(tmp_path):
+    """The acceptance battery: introducing a cross-path cycle, growing a
+    declared leaf an edge, moving an Event.set inside a leaf body, and
+    burying a pickle two calls deep under the runtime lock each produce
+    exactly the expected RTL6xx class on an otherwise-clean copy of the
+    shipped tree."""
+    pkg = str(tmp_path / "ray_tpu")
+    shutil.copytree(PKG_DIR, pkg,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    assert lockgraph.check_paths([pkg]) == [], \
+        "the copied tree must be clean before any mutation"
+
+    def run():
+        return lockgraph.check_paths([pkg])
+
+    # 1. Cross-acquire two worker-side leaves on different call paths ->
+    #    RTL601 cycle (plus RTL602: both ends are declared leaves).
+    path_a, orig_a = _mutate(
+        pkg, "_private/worker_main.py",
+        "            self.pending[req_id] = (slot, msg, time.monotonic())\n",
+        "            self.pending[req_id] = (slot, msg, time.monotonic())\n"
+        "            with self._xfer_lock:\n"
+        "                pass\n")
+    path_b, orig_b = _mutate(
+        pkg, "_private/worker_main.py",
+        "        with self._xfer_lock:\n"
+        "            delta = {}\n",
+        "        with self._xfer_lock:\n"
+        "            with self.pending_lock:\n"
+        "                pass\n"
+        "            delta = {}\n")
+    findings = run()
+    assert any(f.rule == "RTL601" and "pending_lock" in f.message
+               and "_xfer_lock" in f.message for f in findings), findings
+    assert any(f.rule == "RTL602" for f in findings), findings
+    # Same file mutated twice: restore in REVERSE order (orig_b still
+    # contains mutation a; orig_a is pristine).
+    with open(path_b, "w", encoding="utf-8") as f:
+        f.write(orig_b)
+    with open(path_a, "w", encoding="utf-8") as f:
+        f.write(orig_a)
+
+    # 2. Grow the dispatch-dirty leaf an outgoing edge -> RTL602 naming
+    #    the leaf and its annotation site.
+    path, orig = _mutate(
+        pkg, "_private/runtime.py",
+        "            else:\n"
+        "                self._dispatch_dirty.update(keys)\n",
+        "            else:\n"
+        "                self._dispatch_dirty.update(keys)\n"
+        "            with self._dirty_lock:\n"
+        "                pass\n")
+    findings = run()
+    assert any(f.rule == "RTL602" and "_dispatch_dirty_lock" in f.message
+               and "_dirty_lock" in f.message for f in findings), findings
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(orig)
+
+    # 3. Move the dispatch Event.set INSIDE the leaf body -> RTL603 (the
+    #    convention every PR pinned by hand: signal after release).
+    path, orig = _mutate(
+        pkg, "_private/runtime.py",
+        "                self._dispatch_dirty.update(keys)\n"
+        "        self._dispatch_event.set()\n",
+        "                self._dispatch_dirty.update(keys)\n"
+        "            self._dispatch_event.set()\n")
+    findings = run()
+    assert any(f.rule == "RTL603" and "_dispatch_dirty_lock" in f.message
+               for f in findings), findings
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(orig)
+
+    # 4. Bury a pickle two calls deep under the runtime lock -> RTL604
+    #    anchored at the IO site, path named in the message (lexical
+    #    RTL402 cannot see this).
+    path, orig = _mutate(
+        pkg, "_private/runtime.py",
+        '    def _mark_dirty(self, worker: "WorkerHandle"):\n',
+        "    def _lg_mut_outer(self):\n"
+        "        self._lg_mut_inner()\n"
+        "\n"
+        "    def _lg_mut_inner(self):\n"
+        "        serialization.dumps_inline([1])\n"
+        "\n"
+        '    def _mark_dirty(self, worker: "WorkerHandle"):\n')
+    path2, orig2 = _mutate(
+        pkg, "_private/runtime.py",
+        "                with self.lock:\n"
+        "                    self._dispatch_locked(keys)\n",
+        "                with self.lock:\n"
+        "                    self._lg_mut_outer()\n"
+        "                    self._dispatch_locked(keys)\n")
+    findings = run()
+    assert any(f.rule == "RTL604" and "dumps_inline" in f.message
+               and "_lg_mut_outer" in f.message for f in findings), findings
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(orig)
+    with open(path2, "w", encoding="utf-8") as f:
+        f.write(orig2)
+
+    assert run() == [], "restores must return the copy to clean"
+
+
+def test_reasonless_lockgraph_suppression_is_flagged(tmp_path):
+    bad = tmp_path / "bad_noqa.py"
+    bad.write_text(
+        "import threading\n\n\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()  # lock-order: leaf\n"
+        "        self._b_lock = threading.Lock()\n\n"
+        "    def f(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:  # noqa: RTL602\n"
+        "                pass\n")
+    findings = lockgraph.check_paths([str(bad)])
+    assert [f.rule for f in findings] == ["RTL600"]
+    # With a reason, the suppression stands.
+    bad.write_text(bad.read_text().replace(
+        "# noqa: RTL602", "# noqa: RTL602 -- handoff proven by test_x"))
+    assert lockgraph.check_paths([str(bad)]) == []
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def test_cli_exits_nonzero_on_bad_fixture_with_rule_and_line():
+    bad = os.path.join(os.path.dirname(__file__), "lint_fixtures",
+                       "bad_lockgraph.py")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lockgraph", bad],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "RTL601" in proc.stdout
+    assert re.search(r"bad_lockgraph\.py:\d+:\d+", proc.stdout)
+
+
+def test_cli_doc_renders_lock_order_table():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lockgraph", "--doc"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert "| lock | kind | created at |" in proc.stdout
+    for needle in ("runtime.Runtime.lock",
+                   "runtime.Runtime._dispatch_dirty_lock", "leaf",
+                   "io-guard"):
+        assert needle in proc.stdout, needle
+
+
+def test_cli_dump_lists_locks_edges_and_spawns():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lockgraph", "--dump",
+         PKG_DIR],
+        capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert "== locks" in proc.stdout
+    assert "== edges" in proc.stdout
+    assert "== spawn edges" in proc.stdout
+    assert "runtime.Runtime.lock" in proc.stdout
+
+
+def test_main_select_filters_rules(tmp_path, capsys):
+    bad = tmp_path / "bad_select.py"
+    bad.write_text(
+        "import threading\n\n\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()  # lock-order: leaf\n"
+        "        self._b_lock = threading.Lock()\n\n"
+        "    def f(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                pass\n")
+    assert lockgraph.main([str(bad)]) == 1
+    assert "RTL602" in capsys.readouterr().out
+    assert lockgraph.main(["--select=RTL601", str(bad)]) == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_main_exit_codes(capsys):
+    assert lockgraph.main([]) == 2
+    capsys.readouterr()
+    assert lockgraph.main(["no_such_dir/"]) == 2
+    assert "no such path" in capsys.readouterr().err
+    assert lockgraph.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in lockgraph.RULES:
+        assert rule_id in out
+    assert lockgraph.main(["--select=RTL9", PKG_DIR]) == 2
+    assert "matches no rule" in capsys.readouterr().err
