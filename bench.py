@@ -974,6 +974,194 @@ def degraded_link_bench():
     return out
 
 
+def shuffle_bench(rounds=3):
+    """Push-shuffle row: an all-to-all sort + groupby with the PULL-
+    SERVE PLANE paced (env net-chaos ``delay`` on every agent
+    data-chunk send, one claim dir per node so every node's object
+    server is paced, ``object_pool_size=1`` so transfers per peer pair
+    serialize like a real bandwidth-limited link), push engine on vs
+    off on identical data.  The paced resource is the per-node serve
+    path that the legacy engine routes EVERY partition byte through at
+    the reduce barrier; the push engine's whole thesis is that map-side
+    ``put_range`` writes partition bytes straight into the consumer
+    store and never queues behind that plane (its input-block reads
+    still pay the same paced pulls, so the comparison shares the slow
+    plane for everything except the contested partition hop).  Pacing
+    also makes the A/B load-independent on a 2-vCPU host: walls are
+    dominated by deterministic injected sleeps, not scheduler noise.
+    ``max_inline_object_size`` is lowered so the legacy engine's
+    partitions (~320 KB at R=16) are node-store homed and actually
+    traverse the data plane rather than riding head messages.
+
+    ``gbps`` = dataset bytes / wall to full consumption; ``completed``
+    pins exact row counts.  Both modes must keep the head control
+    plane flat — ``head_brokered_submits`` and ``brokered_put_parts``
+    per-run DELTAS zero (no partition payload or spec ever rides a
+    head message).  Best-of-``rounds`` per mode with raw samples
+    (PR 6/7 convention), plus a chaos variant: kill one producer node
+    AND gray-stall another's head link mid-shuffle — lineage rebuild +
+    reducer hedging must still land the exact sorted output."""
+    import pickle
+    import tempfile
+
+    import numpy as np
+
+    import ray_tpu as ray
+    from ray_tpu import data as rd
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.data.dataset import Dataset
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy as NA,
+    )
+
+    n_blocks = 16
+    rows_per_block = 600
+    n_groups = 7
+    delay_ms = 240
+    part_target = 20_000_000  # R=4 on ~80 MB: push partitions ~1.25 MB,
+    # R decoupled from the 16-block count (legacy is locked to R=16).
+
+    def _mk_rows(i):
+        rng = np.random.default_rng(77 + i)
+        return [{"k": float(v), "g": j % n_groups, "v": j,
+                 "p": bytes(8192)}
+                for j, v in enumerate(rng.random(rows_per_block))]
+
+    @ray.remote(max_retries=3)
+    def mk_block(i):
+        return _mk_rows(i)
+
+    block_bytes = len(pickle.dumps(_mk_rows(0), protocol=5))
+    total_bytes = block_bytes * n_blocks
+    total_rows = rows_per_block * n_blocks
+
+    pace = f"agent:chunk_send:delay-{delay_ms}:1"
+
+    def one_round(push_on):
+        cfg = {"push_shuffle": push_on,
+               "shuffle_partition_bytes_target": part_target,
+               "max_inline_object_size": 65536,
+               "object_pool_size": 1}
+        c = Cluster(head_num_cpus=0, _system_config=cfg)
+        try:
+            nodes = [c.add_node(
+                num_cpus=2, external=True,
+                env_overrides={
+                    "RAY_TPU_CHAOS_NET": pace,
+                    # A claim dir PER NODE: the one-shot claim-file
+                    # convention then arms the delay once per node —
+                    # every node's serve plane paced.
+                    "RAY_TPU_CHAOS_DIR": tempfile.mkdtemp(),
+                }) for _ in range(2)]
+            blocks = [mk_block.options(scheduling_strategy=NA(
+                node_id=nodes[i % 2], soft=True)).remote(i)
+                for i in range(n_blocks)]
+            ray.wait(blocks, num_returns=len(blocks), timeout=60)
+
+            def timed(build, expect_rows):
+                st0 = c.rt.transfer_stats()
+                t0 = time.perf_counter()
+                n = build(Dataset(blocks)).count()
+                dt = time.perf_counter() - t0
+                st1 = c.rt.transfer_stats()
+
+                def delta(k):
+                    return st1.get(k, 0) - st0.get(k, 0)
+
+                return {"wall_s": round(dt, 2),
+                        "gbps": round(total_bytes / 1e9 / dt, 4),
+                        "completed": n == expect_rows,
+                        "head_brokered_submits":
+                            delta("head_brokered_submits"),
+                        "brokered_put_parts": delta("brokered_put_parts"),
+                        "shuffle_pushed_bytes":
+                            delta("shuffle_pushed_bytes"),
+                        "shuffle_hedges": delta("shuffle_hedges")}
+
+            sort_row = timed(lambda ds: ds.sort(key="k"), total_rows)
+            grp_row = timed(
+                lambda ds: ds.groupby("g").aggregate(
+                    rd.Sum("v"), rd.Count()), n_groups)
+            return sort_row, grp_row
+        finally:
+            c.shutdown()
+
+    def best_of(push_on):
+        pairs = [one_round(push_on) for _ in range(rounds)]
+
+        def pick(samples):
+            best = min(samples,
+                       key=lambda s: (not s["completed"], -s["gbps"]))
+            return {**best, "samples": samples}
+
+        return (pick([p[0] for p in pairs]),
+                pick([p[1] for p in pairs]))
+
+    def chaos_round():
+        """The drill as a bench row: unpaced 3-node cluster, input
+        blocks homed on the doomed nodes, kill + gray-stall the moment
+        the map wave is submitted."""
+        from ray_tpu.chaos import ChaosController
+
+        fd = {"net_stall_timeout_s": 0.8, "net_connect_timeout_s": 2.0,
+              "net_retry_count": 1, "net_retry_backoff_base_ms": 20.0,
+              "health_check_period_s": 0.25,
+              "health_check_timeout_s": 1.0,
+              "health_check_failure_threshold": 2,
+              "health_check_initial_delay_s": 1.0}
+        c = Cluster(head_num_cpus=2, _system_config=fd)
+        chaos = None
+        try:
+            n1 = c.add_node(num_cpus=2, external=True)
+            n2 = c.add_node(num_cpus=2, external=True)
+            n3 = c.add_node(num_cpus=2, external=True)
+            chaos = ChaosController(c.rt)
+            homes = [n1, n2, n1, n3]
+            blocks = [mk_block.options(scheduling_strategy=NA(
+                node_id=homes[i % len(homes)], soft=True)).remote(i)
+                for i in range(n_blocks)]
+            ray.wait(blocks, num_returns=len(blocks), timeout=60)
+
+            def wreck():
+                chaos.kill_agent(n1)
+                chaos.stall_link(n2)
+
+            chaos.at_syncpoint("shuffle:maps_submitted", wreck, n=1)
+            t0 = time.perf_counter()
+            n = Dataset(blocks).sort(key="k").count()
+            dt = time.perf_counter() - t0
+            st = c.rt.transfer_stats()
+            return {"wall_s": round(dt, 2), "completed": n == total_rows,
+                    "reconstructions": st.get("reconstructions", 0),
+                    "shuffle_hedges": st.get("shuffle_hedges", 0)}
+        finally:
+            if chaos is not None:
+                chaos.stop()
+            c.shutdown()
+
+    sort_push, grp_push = best_of(True)
+    sort_legacy, grp_legacy = best_of(False)
+    try:
+        chaos_row = chaos_round()
+    except Exception as e:  # noqa: BLE001 — extra row must not kill A/B
+        chaos_row = {"error": repr(e)}
+
+    out = {"dataset_mb": round(total_bytes / 1e6, 2),
+           "delay_ms": delay_ms, "rounds": rounds,
+           "sort_push": sort_push, "sort_legacy": sort_legacy,
+           "groupby_push": grp_push, "groupby_legacy": grp_legacy,
+           "chaos": chaos_row}
+    sp, sl = out["sort_push"], out["sort_legacy"]
+    print(f"  [shuffle] sort push {sp['gbps']}GB/s vs legacy "
+          f"{sl['gbps']}GB/s ({sp['gbps'] / max(sl['gbps'], 1e-9):.2f}x),"
+          f" groupby {grp_push['gbps']}GB/s vs {grp_legacy['gbps']}GB/s;"
+          f" chaos completed={chaos_row.get('completed')} "
+          f"(reconstructions={chaos_row.get('reconstructions')}, "
+          f"hedges={chaos_row.get('shuffle_hedges')})",
+          file=sys.stderr)
+    return out
+
+
 def elastic_drill_bench():
     """Elastic-pods row: sustained small-task traffic against an
     autoscaled spot slice pool crosses ONE mid-run preemption — drain
@@ -1413,6 +1601,12 @@ def main():
         degraded_link = {"error": repr(e)}
 
     try:
+        push_shuffle = shuffle_bench()
+    except Exception as e:  # noqa: BLE001 — extra row must not kill core
+        print(f"  [shuffle] bench failed: {e!r}", file=sys.stderr)
+        push_shuffle = {"error": repr(e)}
+
+    try:
         tpu = tpu_bench()
     except Exception as e:  # noqa: BLE001 — device bench must not kill core
         print(f"  [tpu] device bench failed: {e!r}", file=sys.stderr)
@@ -1432,9 +1626,10 @@ def main():
         "head_restart_blip": head_restart_blip,
         "elastic_drill": elastic_drill,
         "degraded_link": degraded_link,
+        "serve_latency": serve_latency,
         # Last (before the small tpu dict): the round artifact keeps the
         # TAIL of this line, and this round's A/B rows live here.
-        "serve_latency": serve_latency,
+        "push_shuffle": push_shuffle,
         "tpu": tpu,
     }))
 

@@ -138,6 +138,31 @@ class Config:
     # CPU count (min 1, fallback 8 when it cannot be read).
     data_max_inflight_tasks: int = 0
 
+    # --- Push-based distributed shuffle (reference: Exoshuffle
+    # (SIGCOMM'23) push-based map output + Ownership (NSDI'21)
+    # pipelined operators).  Master switch for the push-based
+    # all-to-all shuffle behind Dataset.sort/random_shuffle and
+    # GroupedDataset.aggregate/map_groups: map tasks partition rows
+    # and push each partition straight into its reducer's node store
+    # over the striped put verbs (reserve_put/put_range/commit_put),
+    # reducers merge on arrival.  Off = the pre-PR map/reduce fan-out,
+    # byte-identical, with every shuffle counter zero.  Read in the
+    # WORKER process (map tasks + reducer actors), so it rides
+    # _worker_config_env. ---
+    push_shuffle: bool = True
+    # Target bytes per shuffle partition for sort/groupby: the planner
+    # picks the reducer count R ~ total_bytes / target (clamped to
+    # [1, 4 * n_blocks]).  0 = one reducer per input block (R =
+    # n_blocks), which random_shuffle always uses so its seeded
+    # permutation is reproducible across the switch.
+    shuffle_partition_bytes_target: int = 0
+    # Streaming-merge fan-in for sort reducers: once at least this many
+    # sorted runs have arrived, the reducer k-way merges them into one
+    # (heapq.merge, stable on (map_idx, pos) ties) so memory tracks the
+    # run count, not the input count.  Also bounds the merge at
+    # finalize.  Minimum 2.
+    shuffle_merge_fanin: int = 8
+
     # --- Decentralized dispatch (reference: the raylet's lease-based
     # hybrid scheduling, RequestWorkerLease + spillback in
     # local_task_manager.h:58, with task metadata owned by the submitting

@@ -617,6 +617,19 @@ class Runtime:
         self.stall_timeouts = 0
         self.net_retries = 0
         self.hedged_fetches = 0
+        # Push-shuffle counters (all zero while push_shuffle is off —
+        # pinned by tests): shuffle_pushed_bytes = partition bytes map
+        # tasks pushed straight into reducer-node stores (never through
+        # the head), shuffle_merges = k-way merge passes reducers ran
+        # on arrival, shuffle_spills = partitions reserve_put degraded
+        # to spill files under store pressure, shuffle_hedges = pushes
+        # re-routed through a healthy store after a stalled/dead link
+        # (worker deltas via xfer_stats, plus the driver coordinator's
+        # own — merged at transfer_stats time).
+        self.shuffle_pushed_bytes = 0
+        self.shuffle_merges = 0
+        self.shuffle_spills = 0
+        self.shuffle_hedges = 0
         # Drain rendezvous: aid -> Event set when the forced
         # ("checkpoint_now", aid) round-trips as an actor_checkpoint;
         # node_id -> [done_event, outcome, deadline_abs] for that
@@ -2620,6 +2633,16 @@ class Runtime:
                 str(self.config.data_memory_budget_fraction),
             "RAY_TPU_DATA_MAX_INFLIGHT_TASKS":
                 str(self.config.data_max_inflight_tasks),
+            # Push-shuffle knobs: the switch and both tuning knobs are
+            # read in the WORKER process (map tasks partition + push,
+            # reducer actors merge on arrival), and a Dataset consumed
+            # inside a worker plans its shuffle there too.
+            "RAY_TPU_PUSH_SHUFFLE":
+                "1" if self.config.push_shuffle else "0",
+            "RAY_TPU_SHUFFLE_PARTITION_BYTES_TARGET":
+                str(self.config.shuffle_partition_bytes_target),
+            "RAY_TPU_SHUFFLE_MERGE_FANIN":
+                str(self.config.shuffle_merge_fanin),
             "RAY_TPU_DECENTRALIZED_DISPATCH":
                 "1" if self.config.decentralized_dispatch else "0",
             "RAY_TPU_LEASE_SLOTS": str(self.config.lease_slots),
@@ -4598,6 +4621,13 @@ class Runtime:
                 self.stall_timeouts += d.get("stall_timeouts", 0)
                 self.net_retries += d.get("net_retries", 0)
                 self.hedged_fetches += d.get("hedged_fetches", 0)
+                # Push-shuffle deltas from map tasks and reducer
+                # actors (zero with the switch off).
+                self.shuffle_pushed_bytes += d.get(
+                    "shuffle_pushed_bytes", 0)
+                self.shuffle_merges += d.get("shuffle_merges", 0)
+                self.shuffle_spills += d.get("shuffle_spills", 0)
+                self.shuffle_hedges += d.get("shuffle_hedges", 0)
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
         elif tag == "result_batch":
@@ -6404,8 +6434,28 @@ class Runtime:
         # relay stalls) merge with the worker/client deltas aggregated
         # below — one cluster-wide number per counter.
         head_net = protocol.net_stats()
+        # Same pattern for the push-shuffle coordinator: when the
+        # driver IS this head process, its map/merge/hedge work counts
+        # in the shuffle module's process-local registry, not in any
+        # worker's xfer_stats delta.  Lazy module lookup: never imported
+        # (switch off, or no shuffle ran) means all-zero.
+        shuffle_mod = sys.modules.get("ray_tpu.data.shuffle")
+        head_shuf = (shuffle_mod.shuffle_stats() if shuffle_mod is not None
+                     else {})
         with self.lock:
             return {
+                "shuffle_pushed_bytes":
+                    self.shuffle_pushed_bytes
+                    + head_shuf.get("shuffle_pushed_bytes", 0),
+                "shuffle_merges":
+                    self.shuffle_merges
+                    + head_shuf.get("shuffle_merges", 0),
+                "shuffle_spills":
+                    self.shuffle_spills
+                    + head_shuf.get("shuffle_spills", 0),
+                "shuffle_hedges":
+                    self.shuffle_hedges
+                    + head_shuf.get("shuffle_hedges", 0),
                 "suspected_nodes": self.suspected_nodes,
                 "stall_timeouts":
                     self.stall_timeouts + head_net["stall_timeouts"],

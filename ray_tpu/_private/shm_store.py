@@ -639,3 +639,30 @@ class PutReservation:
     def abort(self):
         """Tear down: close + unlink + restore store accounting."""
         self.store._finish_put(self, commit=False)
+
+
+def put_local(store: ShmStore, oid_bin: bytes, meta: bytes,
+              buffers: List[memoryview]):
+    """Write a full segment image into THIS node's store through the
+    same reserve/commit admission the remote put verbs use — the local
+    short-circuit of ``ObjectPusher.push`` (a shuffle map task whose
+    reducer lives on its own node must not dial itself).  Inherits
+    reserve_put's over-capacity degradation, so the return mirrors the
+    pusher's: ``(kind, ident, total)`` with kind ``"shm"`` or
+    ``"spilled"``."""
+    table, offsets, total = segment_layout(meta, buffers)
+    res = store.reserve_put(oid_bin, total)
+    try:
+        mm = res.mm
+        _HEADER.pack_into(mm, 0, _MAGIC, len(table))
+        mm[_HEADER.size: _HEADER.size + len(table)] = table
+        for off, buf in zip(offsets, buffers):
+            if len(buf) >= _PARALLEL_COPY_MIN:
+                _parallel_copy(mm, off, buf)
+            else:
+                mm[off: off + len(buf)] = buf
+    except BaseException:
+        res.abort()
+        raise
+    res.commit()
+    return res.kind, res.ident, total

@@ -26,6 +26,7 @@ import asyncio
 import itertools
 import os
 import queue
+import sys
 import threading
 import time
 import traceback
@@ -402,6 +403,12 @@ class _WorkerRuntime:
         # hedged_fetches) — process-wide in the protocol deadline core,
         # aggregated by the head exactly like the rest.
         cur.update(protocol.net_stats())
+        # Push-shuffle counters, only if a shuffle actually ran in this
+        # process (lazy module lookup: importing the data layer from
+        # every worker just to read zeros would be waste).
+        shuffle_mod = sys.modules.get("ray_tpu.data.shuffle")
+        if shuffle_mod is not None:
+            cur.update(shuffle_mod.shuffle_stats())
         with self._xfer_lock:
             delta = {}
             for k, v in cur.items():
@@ -844,29 +851,46 @@ class _WorkerRuntime:
             # loop once more and re-pull directly as a fresh leader.
         return None
 
+    def resolve_store_addr(self, store):
+        """(addr, caps) of a peer store's object server, cached, or None
+        when the peer has no server right now.  Shared by the pull path
+        and the shuffle map tasks' partition pushes — both need the same
+        never-cache-a-miss behavior so a recovered peer gets its fast
+        path back."""
+        ent = self._store_addrs.get(store)
+        if ent is not None:
+            return ent
+        reply = self._request(
+            lambda rid: ("store_addr", rid, store))
+        # (addr, caps) from this release's head; a bare addr (no
+        # advertised verbs) from an older one.
+        if isinstance(reply, tuple):
+            addr, caps = reply[0], tuple(reply[1] or ())
+        else:
+            addr, caps = reply, ()
+        if not addr:
+            # No server right now (agent dead or mid-restart): do
+            # NOT cache the miss — the next pull re-asks, so a
+            # recovered peer gets its fast path back.  The relay
+            # fallback this returns into is far costlier than the
+            # one extra location lookup.
+            return None
+        ent = self._store_addrs[store] = (addr, caps)
+        return ent
+
+    def forget_store_addr(self, store):
+        """Drop the cached server address after a failed push/pull so a
+        restarted peer re-resolves."""
+        self._store_addrs.pop(store, None)
+
     def _pull_segment_once(self, descr):
         """One actual pull attempt (address resolution + chunk stream);
         returns None instead of raising so singleflight failure wakes
         waiters into their own fallback."""
         store = descr[3]
-        ent = self._store_addrs.get(store)
+        ent = self.resolve_store_addr(store)
         if ent is None:
-            reply = self._request(
-                lambda rid: ("store_addr", rid, store))
-            # (addr, caps) from this release's head; a bare addr (no
-            # advertised verbs) from an older one.
-            if isinstance(reply, tuple):
-                addr, caps = reply[0], tuple(reply[1] or ())
-            else:
-                addr, caps = reply, ()
-            if not addr:
-                # No server right now (agent dead or mid-restart): do
-                # NOT cache the miss — the next pull re-asks, so a
-                # recovered peer gets its fast path back.  The relay
-                # fallback this returns into is far costlier than the
-                # one extra location lookup.
-                return None
-            ent = self._store_addrs[store] = (addr, caps)
+            return None
         addr, caps = ent
         try:
             # One-copy receive: chunks land straight in a local shm

@@ -175,23 +175,33 @@ def _slice_block(block, start, stop):
 @ray.remote
 def _sort_block(block, key, descending):
     rows = list(_block_rows(block))
-    keyfn = (lambda r: r[key]) if isinstance(key, str) else key
-    return sorted(rows, key=keyfn, reverse=descending)
+    keyfn = _keyfn_of(key)
+    return sorted(rows, key=lambda r: _none_key(keyfn(r)),
+                  reverse=descending)
 
 
 @ray.remote
 def _merge_sorted(key, descending, *blocks):
     import heapq
 
-    keyfn = (lambda r: r[key]) if isinstance(key, str) \
-        else (key or (lambda r: r))
-    return list(heapq.merge(*blocks, key=keyfn, reverse=descending))
+    keyfn = _keyfn_of(key)
+    return list(heapq.merge(*blocks,
+                            key=lambda r: _none_key(keyfn(r)),
+                            reverse=descending))
 
 
 def _keyfn_of(key):
     if isinstance(key, str):
         return lambda r: r[key]
     return key or (lambda r: r)
+
+
+def _none_key(v):
+    """None-safe sort decoration — the ``(x is None, x)`` convention
+    grouped_dataset already uses for group keys, applied uniformly to
+    every sort/range-partition comparison so None keys order after all
+    real keys instead of raising TypeError."""
+    return (v is None, v)
 
 
 @ray.remote
@@ -211,14 +221,16 @@ def _sample_block(block, k, key):
 @ray.remote
 def _range_partition(block, key, descending, bounds):
     """Bucket rows by the sampled boundaries: bucket i holds keys in
-    (bounds[i-1], bounds[i]].  num_returns = len(bounds) + 1."""
+    (bounds[i-1], bounds[i]].  ``bounds`` are DECORATED (``_none_key``)
+    so None keys bisect instead of raising.  num_returns =
+    len(bounds) + 1."""
     import bisect
 
     keyfn = _keyfn_of(key)
     n_out = len(bounds) + 1
     buckets = [[] for _ in builtins.range(n_out)]
     for r in _block_rows(block):
-        i = bisect.bisect_left(bounds, keyfn(r))
+        i = bisect.bisect_left(bounds, _none_key(keyfn(r)))
         if descending:
             i = n_out - 1 - i
         buckets[i].append(r)
@@ -228,7 +240,8 @@ def _range_partition(block, key, descending, bounds):
 @ray.remote
 def _sort_range(key, descending, *parts):
     rows = list(itertools.chain(*parts))
-    rows.sort(key=_keyfn_of(key), reverse=descending)
+    keyfn = _keyfn_of(key)
+    rows.sort(key=lambda r: _none_key(keyfn(r)), reverse=descending)
     return rows
 
 
@@ -391,7 +404,12 @@ class Dataset:
         if getattr(cfg, "streaming_executor", True):
             from ray_tpu.data import streaming_executor as _se
 
+            prev = self._stats
             stats = self._stats = _ex.DatasetStats()
+            if prev is not None:
+                # The push-shuffle summary describes how THESE blocks
+                # were produced — keep it visible across consumption.
+                stats.shuffle = prev.shuffle
             stats.note_start()
             produced: List[Any] = []
             for ref in _se.execute(self._segments, rt, cfg, stats,
@@ -413,7 +431,10 @@ class Dataset:
         from ray_tpu.data import execution as _ex
 
         window = window or DEFAULT_STREAMING_WINDOW
+        prev = self._stats
         stats = self._stats = _ex.DatasetStats()
+        if prev is not None:
+            stats.shuffle = prev.shuffle
         stats.note_start()
         pairs = ((b, ops) for blocks, ops in self._segments
                  for b in blocks)
@@ -548,23 +569,72 @@ class Dataset:
             out.append(Dataset(refs))
         return out
 
+    def _try_push_shuffle(self, mode: str, *, key=None,
+                          descending: bool = False, seed: int = 0,
+                          aggs=None, fn=None) -> Optional["Dataset"]:
+        """Route an all-to-all through the push-based shuffle engine
+        (``data/shuffle.py`` + ``streaming_executor.ShuffleOperator``).
+
+        Returns the result Dataset, or None when the push path does not
+        apply and the caller should run the legacy pull shuffle:
+        ``config.push_shuffle`` is off (the module is then never even
+        imported — every shuffle counter stays zero), the driving
+        process is not the head (no node table), fewer than 2 blocks,
+        or no plan could be formed (no alive nodes / no sort samples)."""
+        from ray_tpu._private import api_internal
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        rt = api_internal.get_runtime()
+        if rt is None:
+            return None
+        cfg = getattr(rt, "config", None) or GLOBAL_CONFIG
+        if not getattr(cfg, "push_shuffle", False):
+            return None
+        if not hasattr(rt, "nodes") or not hasattr(rt, "node_order"):
+            return None  # worker- or client-driven dataset
+        blocks = self._executed_refs()
+        if len(blocks) < 2:
+            return None
+        from ray_tpu.data import execution as _ex
+        from ray_tpu.data import shuffle as _sh
+        from ray_tpu.data import streaming_executor as _se
+
+        spec = _sh.ShuffleSpec(mode, key=key, descending=descending,
+                               seed=seed, aggs=aggs, fn=fn)
+        res = _se.ShuffleOperator(spec, rt, cfg).run(blocks)
+        if res is None:
+            return None
+        refs, summary = res
+        out = Dataset(refs)
+        st = self._stats or _ex.DatasetStats()
+        st.shuffle = summary
+        out._stats = st
+        return out
+
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Push-based two-stage shuffle (reference:
         _internal/push_based_shuffle.py): map tasks partition rows to
-        reducers; reduce tasks concat + locally shuffle."""
+        reducers; reduce tasks concat + locally shuffle.  With
+        ``config.push_shuffle`` on, partitions move worker-to-worker
+        over the striped put verbs and reducers shuffle on arrival."""
+        seed = 0 if seed is None else seed
+        pushed = self._try_push_shuffle("random", seed=seed)
+        if pushed is not None:
+            return pushed
         blocks = self._executed_refs()
         n = len(blocks)
         if n == 0:
             return Dataset([])
-        seed = 0 if seed is None else seed
-        parts = [_shuffle_map.options(num_returns=n).remote(b, n, seed + i)
-                 for i, b in enumerate(blocks)]
+        mapper = _shuffle_map.options(num_returns=n)
+        parts = _bulk_submit([(mapper, (b, n, seed + i), None)
+                              for i, b in enumerate(blocks)])
         if n == 1:
             parts = [[p] for p in parts]
-        reducers = []
-        for j in builtins.range(n):
-            reducers.append(_shuffle_reduce.remote(
-                seed + 1000 + j, *[parts[i][j] for i in builtins.range(n)]))
+        reducers = _bulk_submit([
+            (_shuffle_reduce,
+             (seed + 1000 + j, *[parts[i][j] for i in builtins.range(n)]),
+             None)
+            for j in builtins.range(n)])
         return Dataset(reducers)
 
     def sort(self, key: Union[str, Callable, None] = None,
@@ -574,28 +644,37 @@ class Dataset:
         range boundaries, partition rows to P reducers, sort per range.
         Output is P globally-ordered blocks — no single-task merge, no
         O(dataset) memory on one worker (the v1 design concatenated
-        every block in ONE reducer)."""
+        every block in ONE reducer).  With ``config.push_shuffle`` on,
+        range partitions push straight to their reducer's node store and
+        reducers k-way-merge pre-sorted runs on arrival."""
+        pushed = self._try_push_shuffle("sort", key=key,
+                                        descending=descending)
+        if pushed is not None:
+            return pushed
         blocks = self._executed_refs()
         n = len(blocks)
         if n == 0:
             return Dataset([])
         if n == 1:
             return Dataset([_sort_block.remote(blocks[0], key, descending)])
-        samples = ray.get([_sample_block.remote(b, 16, key)
-                           for b in blocks])
-        flat = sorted(s for part in samples for s in part)
+        samples = ray.get(_bulk_submit([
+            (_sample_block, (b, 16, key), None) for b in blocks]))
+        flat = sorted((s for part in samples for s in part), key=_none_key)
         if not flat:
             return Dataset(blocks)
-        # P-1 boundaries at even sample quantiles.
-        bounds = [flat[len(flat) * (i + 1) // n]
+        # P-1 boundaries at even sample quantiles (decorated, so the
+        # partition bisect never compares None against a real key).
+        bounds = [_none_key(flat[len(flat) * (i + 1) // n])
                   for i in builtins.range(n - 1)]
-        parts = [_range_partition.options(num_returns=n).remote(
-            b, key, descending, bounds) for b in blocks]
-        if n == 1:
-            parts = [[p] for p in parts]
-        out = [_sort_range.remote(key, descending,
-                                  *[parts[i][j] for i in builtins.range(n)])
-               for j in builtins.range(n)]
+        mapper = _range_partition.options(num_returns=n)
+        parts = _bulk_submit([(mapper, (b, key, descending, bounds), None)
+                              for b in blocks])
+        out = _bulk_submit([
+            (_sort_range,
+             (key, descending,
+              *[parts[i][j] for i in builtins.range(n)]),
+             None)
+            for j in builtins.range(n)])
         return Dataset(out)
 
     def zip(self, other: "Dataset") -> "Dataset":

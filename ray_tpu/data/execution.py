@@ -204,6 +204,10 @@ class DatasetStats:
         self._wall_start: Optional[float] = None
         self._wall_end: Optional[float] = None
         self.streaming = None  # StreamingStats of the last streaming run
+        # Push-shuffle summary dict of the last shuffle (None when the
+        # legacy pull shuffle ran or push_shuffle is off — then every
+        # shuffle counter in shuffle_summary() reads zero).
+        self.shuffle = None
 
     def note_start(self):
         if self._wall_start is None:
@@ -237,6 +241,16 @@ class DatasetStats:
             return _se.empty_summary()
         return self.streaming.summary()
 
+    def shuffle_summary(self) -> Dict[str, Any]:
+        """Push-shuffle counters of the last run; all-zero when the
+        legacy pull shuffle executed (config.push_shuffle=off, non-head
+        driver, or a single-block dataset)."""
+        if self.shuffle is None:
+            return {"maps": 0, "reducers": 0, "shuffle_pushed_bytes": 0,
+                    "shuffle_merges": 0, "shuffle_spills": 0,
+                    "shuffle_hedges": 0}
+        return dict(self.shuffle)
+
     def _drain(self):
         if not self._stats_refs:
             return
@@ -261,6 +275,15 @@ class DatasetStats:
                 f"  {op}: {agg['blocks']} blocks, "
                 f"{agg['wall_s'] * 1e3:.1f}ms task time, "
                 f"{int(agg['rows_out'])} rows out, {mb:.2f}MB out")
+        if self.shuffle is not None:
+            sh = self.shuffle
+            lines.append(
+                f"Push shuffle: {sh['maps']} maps -> "
+                f"{sh['reducers']} reducers, "
+                f"{sh['shuffle_pushed_bytes'] / 1e6:.2f}MB pushed, "
+                f"{sh['shuffle_merges']} merges, "
+                f"{sh['shuffle_spills']} spills, "
+                f"{sh['shuffle_hedges']} hedges")
         if self.streaming is not None:
             s = self.streaming.summary()
             lines.append(

@@ -164,6 +164,10 @@ class GroupedDataset:
     def aggregate(self, *aggs: AggregateFn) -> Dataset:
         if not aggs:
             raise ValueError("aggregate() needs at least one AggregateFn")
+        pushed = self._ds._try_push_shuffle(
+            "groupby", key=self._key, aggs=list(aggs))
+        if pushed is not None:
+            return pushed
         n, parts = self._shuffled_parts()
         out = _bulk_submit([
             (_agg_reduce,
@@ -175,6 +179,10 @@ class GroupedDataset:
     def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
         """reference: grouped_dataset.py map_groups — fn sees the full
         row list of one group."""
+        pushed = self._ds._try_push_shuffle(
+            "map_groups", key=self._key, fn=fn)
+        if pushed is not None:
+            return pushed
         n, parts = self._shuffled_parts()
         out = _bulk_submit([
             (_map_groups_task,

@@ -1,0 +1,487 @@
+"""Push-based all-to-all shuffle: map-side partition push over the
+striped data plane, merge-on-arrival reduce.
+
+Reference: Exoshuffle (SIGCOMM'23) — shuffle as an application-level
+library over a shared-memory object store with push-based map output —
+and the pipelined-operator argument of Ownership (NSDI'21).  The legacy
+shuffles behind ``Dataset.random_shuffle``/``sort`` and
+``GroupedDataset`` are pull-based: each map task returns N partition
+objects and each reduce task takes N of them as *arguments*, so N
+blocks x N reducers puts O(N^2) objects in the head's table and every
+partition byte rides the arg-fetch path at reduce start, serializing
+transfer behind compute.  This engine inverts the flow:
+
+- **Map side** (``_shuffle_map_push``): partition one block's rows
+  (range partition for sort, key hash for groupby, seeded RNG for
+  random_shuffle), serialize each partition, and push its segment image
+  straight into the *reducer's* node store over the direct-put verbs
+  (``reserve_put``/``put_range``/``commit_put`` — a partition is just a
+  segment image, and ``ObjectPusher`` already knows how to stripe one).
+  Only tiny descriptors ``(kind, ident, total, store, nrows, hedged)``
+  ride the task result; no partition payload ever crosses a head
+  message.  A push to one's OWN store short-circuits through
+  ``shm_store.put_local`` (same admission, no wire).
+- **Reduce side** (``_ShuffleReducer`` actor): partitions are consumed
+  as they arrive — a streaming k-way merge of sorted runs for sort
+  (``shuffle_merge_fanin`` bounds held runs), contiguous-range
+  accumulator merging for groupby/aggregate, concat+seeded-shuffle for
+  random_shuffle — instead of waiting for all N inputs.  Admission is
+  spill-aware by construction: ``reserve_put`` degrades over-capacity
+  partitions to spill files, and the reducer attaches those by path.
+- **Fault story** composes from existing planes: a lost partition means
+  re-running ONE map task (its input block rebuilt by PR 9 lineage if
+  needed), never restarting the shuffle; a *stalled* reducer link trips
+  the PR 14 deadline core inside ``ObjectPusher.push`` and the map task
+  hedges the partition into its own healthy store (the reducer then
+  pulls it over the data plane).  The driver-side coordinator
+  (``streaming_executor.ShuffleOperator``) rebuilds a dead reducer on a
+  healthy node from per-partition re-maps.
+
+Exact-equality contract: with distinct (or integer-exact) data the push
+path reproduces the legacy output bit-for-bit — sort merges on the
+strict key ``(key, map_idx, pos)`` (the tie order a stable sort of the
+map-order concatenation produces), groupby merges partial accumulators
+in map order, random_shuffle re-applies the legacy per-reducer seeds.
+``config.push_shuffle=off`` never imports this module from workers and
+runs the pre-PR path byte-identical with every counter zero.
+
+LOCK ORDER: ``_STATS_LOCK`` is an independent LEAF — it guards only the
+process-local counter dict read by ``shuffle_stats()`` (the xfer_stats
+flusher / ``transfer_stats()`` merge); no other lock is ever acquired
+while holding it and it is never held across serialization, a push, or
+any wire call.  Pinned in tests/test_lockcheck.py next to the
+StreamingStats leaf.
+"""
+
+from __future__ import annotations
+
+import builtins
+import heapq
+import itertools
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu as ray
+
+
+# ------------------------------------------------------------- counters --
+# Process-local cumulative counters.  In workers they ride the periodic
+# ("xfer_stats", delta) flush (worker_main.flush_xfer_stats looks this
+# module up lazily); in the driver/head process transfer_stats() merges
+# them directly.  All zero while push_shuffle is off — pinned by tests.
+_STATS_LOCK = threading.Lock()  # lock-order: leaf (see module docstring)
+_STATS = {
+    "shuffle_pushed_bytes": 0,
+    "shuffle_merges": 0,
+    "shuffle_spills": 0,
+    "shuffle_hedges": 0,
+}
+
+
+def note(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        _STATS[key] += n
+
+
+def shuffle_stats() -> Dict[str, int]:
+    """Cumulative snapshot (monotonic — the flusher ships deltas).
+    Deliberately NOT named ``stats()``: protocheck's counter-survival
+    rule scans worker modules' ``stats()`` providers, and this module's
+    keys are aggregated through the lazy flush hook instead."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+# ------------------------------------------------------------------ spec --
+class ShuffleSpec:
+    """Everything a map task / reducer needs to know about one shuffle.
+
+    ``mode`` is ``"sort"`` / ``"groupby"`` / ``"map_groups"`` /
+    ``"random"``.  ``bounds`` (sort only) holds the None-safe DECORATED
+    range boundaries the coordinator sampled.  Plain attributes so
+    cloudpickle ships the key/agg/fn callables like any task arg."""
+
+    def __init__(self, mode: str, key=None, descending: bool = False,
+                 seed: int = 0, aggs: Optional[list] = None, fn=None,
+                 bounds: Optional[list] = None, merge_fanin: int = 8):
+        self.mode = mode
+        self.key = key
+        self.descending = descending
+        self.seed = seed
+        self.aggs = aggs
+        self.fn = fn
+        self.bounds = bounds
+        self.merge_fanin = max(2, int(merge_fanin))
+
+
+class _Rev:
+    """Order-inverting key wrapper: descending sort merges still need
+    ASCENDING (map_idx, pos) tie order — the order a stable
+    ``reverse=True`` sort of the map-order concatenation yields — so the
+    primary key alone inverts inside the strict merge tuple."""
+
+    __slots__ = ("k",)
+
+    def __init__(self, k):
+        self.k = k
+
+    def __lt__(self, other):
+        return other.k < self.k
+
+    def __eq__(self, other):
+        return other.k == self.k
+
+
+def _strict_key(spec: "ShuffleSpec", keyfn, map_idx: int):
+    """row, pos -> the total-order merge key ``(key, map_idx, pos)``.
+    Strictness (no ties anywhere) is what makes merge-on-arrival safe:
+    intermediate merges of out-of-order run subsets cannot perturb the
+    final order."""
+    if spec.descending:
+        return lambda r, pos: ((_Rev(_none_key(keyfn(r))), map_idx, pos))
+    return lambda r, pos: ((_none_key(keyfn(r)), map_idx, pos))
+
+
+def _none_key(v):
+    """The repo-wide None-safe sort decoration (grouped_dataset's
+    ``(x is None, x)`` convention): None keys order after every real
+    key instead of raising TypeError."""
+    return (v is None, v)
+
+
+def _keyfn_of(key):
+    from ray_tpu.data.dataset import _keyfn_of as _k
+
+    return _k(key)
+
+
+# ------------------------------------------------------------- map side --
+def _partition_rows(rows: List[Any], spec: ShuffleSpec,
+                    num_reducers: int, map_idx: int) -> List[List[Any]]:
+    """One block's rows -> per-reducer row lists, exactly mirroring the
+    legacy partitioners (same RNG streams, same bisection) so the two
+    engines bucket identically."""
+    import bisect
+
+    n = num_reducers
+    buckets: List[List[Any]] = [[] for _ in builtins.range(n)]
+    if spec.mode == "random":
+        rng = np.random.default_rng(spec.seed + map_idx)
+        assignment = rng.integers(0, n, size=len(rows))
+        for r, a in zip(rows, assignment):
+            buckets[a].append(r)
+        return buckets
+    keyfn = _keyfn_of(spec.key)
+    if spec.mode == "sort":
+        bounds = spec.bounds or []
+        n_out = len(bounds) + 1
+        for r in rows:
+            i = bisect.bisect_left(bounds, _none_key(keyfn(r)))
+            if spec.descending:
+                i = n_out - 1 - i
+            buckets[i].append(r)
+        # Pre-sort each partition into a run (stable, so equal keys keep
+        # block-row order = the tie order the legacy concat-then-stable-
+        # sort reducer produces); the reducer then only merges.
+        for b in buckets:
+            b.sort(key=lambda r: _none_key(keyfn(r)),
+                   reverse=spec.descending)
+        return buckets
+    # groupby / map_groups: the legacy _hash_partition bucketing.
+    for r in rows:
+        buckets[hash(keyfn(r)) % n].append(r)
+    return buckets
+
+
+def _push_partition(rows: List[Any], store: str) -> tuple:
+    """Serialize one partition and land its segment image in ``store``:
+    local short-circuit through ``put_local``, else a striped
+    ``ObjectPusher.push``.  A failed/stalled/unsupported remote push
+    HEDGES into the map worker's own store (the reducer pulls it over
+    the data plane) — the shuffle never dies on one gray link.  Returns
+    the descriptor ``(kind, ident, total, home_store, nrows, hedged)``."""
+    from ray_tpu._private import api_internal, object_transfer, serialization
+    from ray_tpu._private import shm_store as shm_mod
+    from ray_tpu._private.ids import ObjectID
+
+    if not rows:
+        # Nothing to ship: a zero-byte sentinel descriptor (the reducer
+        # still sees the accept, so groupby's map-range coalescing and
+        # random_shuffle's concat order stay complete).
+        return ("empty", "", 0, "", 0, False)
+    rt = api_internal.require_runtime()
+    res = serialization.dumps_adaptive(rows, 0)  # max_inline=0: parts form
+    meta, bufs = res[1], res[2]
+    oid_bin = ObjectID.for_put().binary()
+    hedged = False
+    if store != rt.store_id:
+        ent = rt.resolve_store_addr(store)
+        if ent is not None and object_transfer.peer_accepts_puts(ent[1]):
+            try:
+                kind, ident, total = rt._pusher.push(
+                    store, ent[0], oid_bin, meta, bufs, caps=ent[1])
+                note("shuffle_pushed_bytes", total)
+                if kind == "spilled":
+                    note("shuffle_spills")
+                return (kind, ident, total, store, len(rows), False)
+            except Exception:
+                # Dead or stalled-past-deadline link (the pusher already
+                # retried with backoff under the PR 14 deadline core):
+                # fall through to the local hedge.
+                rt.forget_store_addr(store)
+        hedged = True
+        note("shuffle_hedges")
+    kind, ident, total = shm_mod.put_local(rt.shm, oid_bin, meta, bufs)
+    note("shuffle_pushed_bytes", total)
+    if kind == "spilled":
+        note("shuffle_spills")
+    return (kind, ident, total, rt.store_id, len(rows), hedged)
+
+
+@ray.remote
+def _shuffle_map_push(block, spec: ShuffleSpec, map_idx: int,
+                      target_stores: List[str],
+                      only_parts: Optional[tuple] = None):
+    """Partition one block and push every partition to its reducer's
+    store.  ``only_parts`` restricts the pushes (per-partition re-maps
+    after a reducer loss — the partitioning pass still runs in full so
+    bucketing stays identical).  Returns one descriptor per reducer
+    (None for skipped partitions)."""
+    from ray_tpu.data.dataset import _block_rows
+
+    rows = list(_block_rows(block))
+    parts = _partition_rows(rows, spec, len(target_stores), map_idx)
+    out: List[Optional[tuple]] = []
+    for j, prows in enumerate(parts):
+        if only_parts is not None and j not in only_parts:
+            out.append(None)
+            continue
+        out.append(_push_partition(prows, target_stores[j]))
+    return out
+
+
+# ---------------------------------------------------------- reduce side --
+@ray.remote(num_cpus=0)
+class _ShuffleReducer:
+    """One reducer: merges partitions ON ARRIVAL instead of waiting for
+    all N map inputs.  ``num_cpus=0`` so R reducers never starve the map
+    wave of execution slots on a small cluster (they are merge/IO-bound
+    and spend their life blocked in ``accept``).
+
+    Single-threaded by the actor model — no locks; the strict merge key
+    makes arrival order irrelevant to the final output (see module
+    docstring)."""
+
+    def __init__(self, spec: ShuffleSpec, reducer_idx: int):
+        self._spec = spec
+        self._idx = reducer_idx
+        self._segs: List[Any] = []   # attached partition segments, kept
+        #                              alive until release() — loaded
+        #                              rows may be zero-copy views
+        self._runs: List[List[tuple]] = []      # sort: strict-key runs
+        self._partials: List[list] = []  # groupby: [start, end, accs]
+        self._rows: List[tuple] = []     # map_groups: (map_idx, pos, row)
+        self._parts: Dict[int, List[Any]] = {}  # random: map_idx -> rows
+        self._merges = 0
+        self._accepted = 0
+
+    # -- partition intake -------------------------------------------------
+    def _load(self, descr: tuple) -> List[Any]:
+        """Descriptor -> row list.  Locally-homed partitions attach by
+        name/path and unlink immediately (the mapping stays readable
+        until release()); hedged remote-homed ones pull over the data
+        plane through the runtime's materialize path."""
+        from ray_tpu._private import api_internal, protocol
+
+        kind, ident, total, store, _nrows, _hedged = descr
+        rt = api_internal.require_runtime()
+        if store == rt.store_id:
+            if kind == "spilled":
+                seg = rt.shm.attach_path(ident)
+                self._segs.append(seg)
+                rows = seg.deserialize()
+                try:
+                    os.unlink(ident)
+                except OSError:
+                    pass
+            else:
+                seg = rt.shm.attach(ident)
+                self._segs.append(seg)
+                rows = seg.deserialize()
+                # Owner-routed free: releases the node byte accounting
+                # the pusher's reserve_put charged.
+                rt.shm.unlink(ident, total)
+            return rows
+        pkind = protocol.SHM if kind == "shm" else protocol.SPILLED
+        return rt.materialize((pkind, ident, total, store))
+
+    def accept(self, map_idx: int, descr: tuple) -> int:
+        spec = self._spec
+        rows = [] if descr[0] == "empty" else self._load(descr)
+        self._accepted += 1
+        if spec.mode == "sort":
+            if not rows:
+                return 0
+            keyfn = _keyfn_of(spec.key)
+            sk = _strict_key(spec, keyfn, map_idx)
+            run = [(*sk(r, pos), r) for pos, r in enumerate(rows)]
+            self._runs.append(run)
+            if len(self._runs) >= spec.merge_fanin:
+                # Streaming k-way merge: held runs collapse into one, so
+                # memory tracks the fan-in knob, not the map count.
+                merged = list(heapq.merge(*self._runs))
+                self._runs = [merged]
+                self._merges += 1
+                note("shuffle_merges")
+        elif spec.mode == "groupby":
+            self._fold_groupby(map_idx, rows)
+        elif spec.mode == "map_groups":
+            for pos, r in enumerate(rows):
+                self._rows.append((map_idx, pos, r))
+        else:  # random
+            self._parts[map_idx] = rows
+        return len(rows)
+
+    def _fold_groupby(self, map_idx: int, rows: List[Any]) -> None:
+        """Fold one arriving partition into a per-map-range partial
+        accumulator set, then merge CONTIGUOUS ranges on arrival — the
+        merge order is then always map order, the order the legacy
+        single-pass fold consumes rows in."""
+        spec = self._spec
+        keyfn = _keyfn_of(spec.key)
+        aggs = spec.aggs
+        accs: Dict[Any, list] = {}
+        for r in rows:
+            k = keyfn(r)
+            acc = accs.get(k)
+            if acc is None:
+                acc = accs[k] = [a.init() for a in aggs]
+            for i, a in enumerate(aggs):
+                acc[i] = a.accumulate(acc[i], r)
+        self._partials.append([map_idx, map_idx, accs])
+        self._partials.sort(key=lambda p: p[0])
+        # Coalesce neighbors while any adjacent map ranges touch.
+        merged_any = True
+        while merged_any:
+            merged_any = False
+            for i in builtins.range(len(self._partials) - 1):
+                lo, hi = self._partials[i], self._partials[i + 1]
+                if lo[1] + 1 == hi[0]:
+                    self._merge_partials(lo, hi)
+                    del self._partials[i + 1]
+                    merged_any = True
+                    self._merges += 1
+                    note("shuffle_merges")
+                    break
+
+    def _merge_partials(self, lo: list, hi: list) -> None:
+        aggs = self._spec.aggs
+        for k, hacc in hi[2].items():
+            lacc = lo[2].get(k)
+            if lacc is None:
+                lo[2][k] = hacc
+            else:
+                for i, a in enumerate(aggs):
+                    lacc[i] = a.merge(lacc[i], hacc[i])
+        lo[1] = hi[1]
+
+    # -- output -----------------------------------------------------------
+    def finalize(self):
+        spec = self._spec
+        if spec.mode == "sort":
+            if len(self._runs) > 1:
+                self._merges += 1
+                note("shuffle_merges")
+            out = [t[-1] for t in heapq.merge(*self._runs)]
+            self._runs = []
+            return out
+        if spec.mode == "groupby":
+            # Stragglers (non-contiguous ranges) merge here, still in
+            # map order; then emit exactly like the legacy _agg_reduce.
+            while len(self._partials) > 1:
+                self._merge_partials(self._partials[0], self._partials[1])
+                del self._partials[1]
+                self._merges += 1
+                note("shuffle_merges")
+            accs = self._partials[0][2] if self._partials else {}
+            key_col = spec.key if isinstance(spec.key, str) else "key"
+            out = []
+            for k in sorted(accs, key=_none_key):
+                row = {key_col: k}
+                for a, acc in zip(spec.aggs, accs[k]):
+                    row[a.name] = a.finalize(acc)
+                out.append(row)
+            self._partials = []
+            return out
+        if spec.mode == "map_groups":
+            keyfn = _keyfn_of(spec.key)
+            groups: Dict[Any, list] = {}
+            # (map_idx, pos) order inside each group = the legacy
+            # concat-in-map-order row order fn() observes.
+            for map_idx, pos, r in sorted(
+                    self._rows, key=lambda t: (t[0], t[1])):
+                groups.setdefault(keyfn(r), []).append(r)
+            self._merges += 1
+            note("shuffle_merges")
+            out = []
+            for k in sorted(groups, key=_none_key):
+                res = spec.fn(groups[k])
+                out.extend(res if isinstance(res, list) else [res])
+            self._rows = []
+            return out
+        # random: legacy _shuffle_reduce with the same per-reducer seed.
+        rows = list(itertools.chain(
+            *(self._parts[i] for i in sorted(self._parts))))
+        rng = np.random.default_rng(spec.seed + 1000 + self._idx)
+        rng.shuffle(rows)
+        self._merges += 1
+        note("shuffle_merges")
+        self._parts = {}
+        return rows
+
+    def stats(self) -> Dict[str, int]:
+        return {"merges": self._merges, "accepted": self._accepted}
+
+    def release(self) -> None:
+        """Close the partition mappings once the coordinator has seen
+        the finalize result land in the store (rows loaded from them may
+        be zero-copy views, so this must not run earlier)."""
+        segs, self._segs = self._segs, []
+        for seg in segs:
+            try:
+                seg.close()
+            except Exception:
+                pass
+
+
+# ------------------------------------------------------------- planning --
+def reduce_targets(rt, num_reducers: int) -> List[Tuple[str, str]]:
+    """Round-robin reducer placement over alive, non-draining nodes:
+    ``[(node_id_hex, store_id), ...]`` of length ``num_reducers``.
+    Returns [] when the runtime has no node table (worker/client-driven
+    datasets fall back to the legacy path)."""
+    try:
+        with rt.lock:
+            nodes = [(n.node_id.hex(), n.store_id or rt.store_id)
+                     for n in (rt.nodes[nid] for nid in rt.node_order)
+                     if n.alive and not n.draining]
+    except AttributeError:
+        return []
+    if not nodes:
+        return []
+    return [nodes[j % len(nodes)] for j in builtins.range(num_reducers)]
+
+
+def pick_reducer_count(cfg, n_blocks: int, total_bytes: int,
+                       mode: str) -> int:
+    """R for one shuffle: one reducer per input block unless a bytes
+    target is set (sort/groupby only — random_shuffle keeps R=n so its
+    seeded permutation is reproducible across the switch)."""
+    target = int(getattr(cfg, "shuffle_partition_bytes_target", 0) or 0)
+    if mode == "random" or target <= 0 or total_bytes <= 0:
+        return max(1, n_blocks)
+    want = (total_bytes + target - 1) // target
+    return max(1, min(int(want), 4 * n_blocks))

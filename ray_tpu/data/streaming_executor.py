@@ -44,6 +44,15 @@ The executor is *driven entirely by the consuming generator's thread*:
 operator queues, in-flight maps and byte accounting are single-threaded
 state and need no locks.
 
+``ShuffleOperator`` (bottom of this module) is the driver-side
+coordinator of the push-based all-to-all shuffle (``data/shuffle.py``):
+it plans reducer placement, fans the map wave out through
+``_bulk_submit``, forwards partition *descriptors* to reducer actors as
+each map completes (merge-on-arrival — reducers never wait for the full
+map wave), and rebuilds a lost reducer from per-partition re-maps.  It
+is driven entirely by the consuming thread and holds no locks; the only
+shuffle lock is the counter leaf in ``data/shuffle.py``.
+
 LOCK ORDER: ``StreamingStats._lock`` is an independent LEAF — it guards
 only the counter snapshot read by ``Dataset.stats()`` (potentially from
 another thread, mid-stream); no other lock is ever acquired while
@@ -543,3 +552,195 @@ def execute(segments, rt, cfg, dstats, window=None):
             _complete_batch(done)
     finally:
         _cancel_outstanding()
+
+
+class ShuffleOperator:
+    """Driver-side coordinator for the push-based all-to-all shuffle.
+
+    The operator owns the *plan* — how many reducers, where they live,
+    which map produces which partition — while all data movement happens
+    worker-to-worker through the striped put verbs (``data/shuffle.py``).
+    Only descriptors (a few dozen bytes per partition) ever transit the
+    head.  ``run`` returns ``(out_refs, summary)`` on success or ``None``
+    when no plan could be formed (no alive nodes, or — for sort — no
+    sample keys); the caller then falls back to the legacy pull path.
+
+    Fault story:
+
+    * a dead **map** task is re-run by the ordinary task-retry machinery
+      (``max_retries``), with its input block rebuilt through lineage;
+    * a partition whose *home* store died is re-materialised from the
+      map hedge copy or, failing that, triggers the same lineage path;
+    * a dead/stuck **reducer** is rebuilt on a different node from
+      per-partition re-maps (``only_parts``) — bounded rounds, counted
+      in ``shuffle_hedges``.
+    """
+
+    MAX_REBUILD_ROUNDS = 2
+    SAMPLES_PER_BLOCK = 16
+
+    def __init__(self, spec, rt, cfg):
+        self.spec = spec
+        self.rt = rt
+        self.cfg = cfg
+
+    # -- planning -----------------------------------------------------
+
+    def _sort_bounds(self, blocks, num_reducers):
+        """Sample keys and compute the R-1 decorated range boundaries.
+
+        Identical sampling (``_sample_block``, 16 evenly spaced rows per
+        block) and identical boundary *positions* to the legacy sort, so
+        push on/off produce byte-identical output.  Returns False when
+        no keys were sampled (all blocks empty) — caller falls back.
+        """
+        from ray_tpu.data import dataset as _ds
+        from ray_tpu.data import shuffle as _sh
+        from ray_tpu.remote_function import _bulk_submit
+
+        refs = _bulk_submit([
+            (_ds._sample_block, (b, self.SAMPLES_PER_BLOCK, self.spec.key),
+             None)
+            for b in blocks])
+        samples = ray.get(refs)
+        flat = sorted((s for part in samples for s in part),
+                      key=_sh._none_key)
+        if not flat:
+            return False
+        self.spec.bounds = [
+            _sh._none_key(flat[len(flat) * (i + 1) // num_reducers])
+            for i in range(num_reducers - 1)]
+        return True
+
+    # -- reducer lifecycle --------------------------------------------
+
+    def _spawn_reducer(self, idx, node_hex):
+        from ray_tpu.data import shuffle as _sh
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy as NA)
+
+        return _sh._ShuffleReducer.options(
+            scheduling_strategy=NA(node_id=node_hex, soft=True),
+        ).remote(self.spec, idx)
+
+    def _rebuild_reducer(self, j, blocks, targets):
+        """Stand up a replacement for reducer ``j`` and re-feed it.
+
+        Re-runs every map for partition ``j`` only (``only_parts``) so
+        the re-map wave moves 1/R of the shuffle, not all of it, and
+        points the fresh partitions at the replacement's store.
+        """
+        from ray_tpu.data import shuffle as _sh
+        from ray_tpu.remote_function import _bulk_submit
+
+        alive = _sh.reduce_targets(self.rt, len(targets))
+        if not alive:
+            raise RuntimeError("push shuffle: no alive node to rebuild "
+                               f"reducer {j} on")
+        bad = targets[j]
+        pick = next((t for t in alive if t != bad), alive[j % len(alive)])
+        targets[j] = pick
+        _sh.note("shuffle_hedges")
+        actor = self._spawn_reducer(j, pick[0])
+        stores = [s for _nid, s in targets]
+        refs = _bulk_submit([
+            (_sh._shuffle_map_push, (b, self.spec, i, stores, (j,)), None)
+            for i, b in enumerate(blocks)])
+        accepts = []
+        for i, descrs in enumerate(ray.get(refs)):
+            accepts.append(actor.accept.remote(i, descrs[j]))
+        return actor, accepts
+
+    # -- the shuffle itself -------------------------------------------
+
+    def run(self, blocks):
+        from ray_tpu._private import recovery
+        from ray_tpu.data import shuffle as _sh
+        from ray_tpu.remote_function import _bulk_submit
+
+        blocks = list(blocks)
+        n = len(blocks)
+        sizes = _descr_nbytes_many(self.rt, blocks)
+        num_r = _sh.pick_reducer_count(
+            self.cfg, n, sum(sizes), self.spec.mode)
+        self.spec.merge_fanin = max(
+            2, int(getattr(self.cfg, "shuffle_merge_fanin", 8)))
+        targets = _sh.reduce_targets(self.rt, num_r)
+        if not targets:
+            return None
+        if self.spec.mode == "sort" and not self._sort_bounds(blocks, num_r):
+            return None
+
+        stores = [s for _nid, s in targets]
+        reducers = [self._spawn_reducer(j, nid)
+                    for j, (nid, _s) in enumerate(targets)]
+        map_refs = _bulk_submit([
+            (_sh._shuffle_map_push, (b, self.spec, i, stores), None)
+            for i, b in enumerate(blocks)])
+        recovery.syncpoint("shuffle:maps_submitted", maps=n, reducers=num_r)
+
+        # Merge-on-arrival: forward each map's descriptors the moment the
+        # map lands; reducers fold/merge concurrently with later maps.
+        accept_refs = [[] for _ in range(num_r)]
+        pushed_bytes = spills = hedges = 0
+        pending = {ref: i for i, ref in enumerate(map_refs)}
+        while pending:
+            done, rest = ray.wait(list(pending), num_returns=1, timeout=None)
+            if rest:
+                more, _ = ray.wait(rest, num_returns=len(rest), timeout=0)
+                done.extend(more)
+            for ref in done:
+                i = pending.pop(ref)
+                descrs = ray.get(ref)  # raises after retries exhausted
+                for j, d in enumerate(descrs):
+                    if d is None:
+                        continue
+                    pushed_bytes += d[2]
+                    spills += 1 if d[0] == "spilled" else 0
+                    hedges += 1 if d[5] else 0
+                    accept_refs[j].append(reducers[j].accept.remote(i, d))
+
+        # Actor calls from one submitter run in order, so a finalize
+        # queued now executes only after every accept above — dispatch
+        # all finalizes up front and let the R merges finish in parallel.
+        final_refs = [r.finalize.remote() for r in reducers]
+
+        merges = 0
+        outs: List[Any] = [None] * num_r
+        for j in range(num_r):
+            err = None
+            for _round in range(self.MAX_REBUILD_ROUNDS + 1):
+                try:
+                    # A failed accept leaves finalize's output silently
+                    # partial — verify the accepts *before* trusting it.
+                    ray.get(accept_refs[j])
+                    ray.wait([final_refs[j]], num_returns=1, timeout=None)
+                    # Tiny liveness probe: surfaces a reducer that died
+                    # mid-finalize without pulling the output block here.
+                    rstats = ray.get(reducers[j].stats.remote())
+                    merges += rstats.get("merges", 0)
+                    outs[j] = final_refs[j]
+                    err = None
+                    break
+                except Exception as e:  # noqa: BLE001 - rebuild on any loss
+                    err = e
+                    reducers[j], accept_refs[j] = self._rebuild_reducer(
+                        j, blocks, targets)
+                    final_refs[j] = reducers[j].finalize.remote()
+                    hedges += 1
+            if err is not None:
+                raise err
+
+        for r in reducers:
+            # Drop zero-copy segment pins; outputs are materialised.
+            r.release.remote()
+
+        summary = {
+            "maps": n,
+            "reducers": num_r,
+            "shuffle_pushed_bytes": pushed_bytes,
+            "shuffle_merges": merges,
+            "shuffle_spills": spills,
+            "shuffle_hedges": hedges,
+        }
+        return outs, summary

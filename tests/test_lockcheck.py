@@ -406,6 +406,42 @@ def test_streaming_stats_lock_convention(checker):
     checker.assert_acyclic()
 
 
+def test_shuffle_stats_lock_convention(checker, monkeypatch):
+    """data/shuffle._STATS_LOCK's documented convention: an independent
+    LEAF — it guards only the process-local shuffle counter dict read by
+    ``shuffle_stats()`` (the xfer_stats flusher / transfer_stats merge)
+    and is never held across serialization, a push, or any wire call.
+    The recorded acquisition graph must show zero outgoing edges from
+    the stats lock across the note/snapshot paths."""
+    from ray_tpu.data import shuffle as _sh
+
+    # Module-level lock predates install(): swap in one created under
+    # instrumentation (the _copy_pool_lock test's pattern).
+    monkeypatch.setattr(_sh, "_STATS_LOCK", threading.Lock())
+    monkeypatch.setattr(_sh, "_STATS", {
+        "shuffle_pushed_bytes": 0, "shuffle_merges": 0,
+        "shuffle_spills": 0, "shuffle_hedges": 0})
+    assert isinstance(_sh._STATS_LOCK, lockcheck._LockProxy)
+    _sh.note("shuffle_pushed_bytes", 4096)
+    _sh.note("shuffle_merges")
+    # Concurrent reader (the flush-thread shape) while the "map task"
+    # keeps counting.
+    got = []
+    reader = threading.Thread(
+        target=lambda: got.append(_sh.shuffle_stats()))
+    reader.start()
+    _sh.note("shuffle_hedges")
+    reader.join(timeout=5)
+    assert got and got[0]["shuffle_pushed_bytes"] == 4096
+    assert _sh.shuffle_stats()["shuffle_merges"] == 1
+    stats_site = _sh._STATS_LOCK._site
+    edges = checker.edges()
+    assert edges.get(stats_site, set()) == set(), (
+        f"a lock was acquired while holding the shuffle-stats lock: "
+        f"{edges.get(stats_site)}")
+    checker.assert_acyclic()
+
+
 def test_lineage_table_lock_is_leaf(checker):
     """recovery.LineageTable._lock's documented convention: an
     independent LEAF.  Both owners take it while already holding their

@@ -88,6 +88,7 @@ def test_lock_graph_agrees_with_lockcheck_leaf_conventions():
         ("continuous.py", "_ContinuousBatcher", "_lock"),
         ("shm_store.py", "ShmStore", "_lock"),
         ("shm_store.py", None, "_copy_pool_lock"),
+        ("shuffle.py", None, "_STATS_LOCK"),
     }
     missing = expected - leaves
     assert not missing, (
@@ -185,7 +186,22 @@ def test_seeded_mutations_each_produce_the_expected_finding(tmp_path):
     with open(path, "w", encoding="utf-8") as f:
         f.write(orig)
 
-    # 6. Drop a serving-memory counter from the controller rollup ->
+    # 6. Remove the push-shuffle switch from _worker_config_env ->
+    #    RTL504: the knob is read in the WORKER process (map tasks and
+    #    worker-driven datasets) and would silently stop following
+    #    _system_config there.
+    path, orig = _mutate(
+        pkg, "_private/runtime.py",
+        '            "RAY_TPU_PUSH_SHUFFLE":\n'
+        '                "1" if self.config.push_shuffle else "0",\n',
+        '')
+    findings = run()
+    assert any(f.rule == "RTL504" and "push_shuffle" in f.message
+               for f in findings), findings
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(orig)
+
+    # 7. Drop a serving-memory counter from the controller rollup ->
     #    RTL504 anchored at the batcher/engine stats dict that ships it
     #    (the serve-plane twin of the xfer-stats survival rule).
     path, orig = _mutate(
