@@ -1162,6 +1162,246 @@ def shuffle_bench(rounds=3):
     return out
 
 
+def pipeline_train_bench(rounds=3):
+    """Distributed pipeline-training row: a 2-stage llama-tiny actor
+    pipeline on two paced external nodes (same env net-chaos pacing as
+    the shuffle row: every data-plane chunk send — activation/grad
+    stripe pushes included — pays a deterministic delay, so the A/B is
+    load-independent and transfer cost is really on the wire).  The SAME
+    trainer steps under both schedules, so weights, jit caches, and the
+    paced link are identical: ``fill_drain`` drives synchronous per-
+    stage wave barriers (the GPipe shape with every transfer on the
+    critical path), ``1f1b`` the async one-forward-one-backward
+    submission that overlaps microbatch t+1's transfer with t's compute
+    across stages.  M = 2*pp microbatches (the 1F1B steady-state
+    sweet spot).
+
+    ``tok_s`` = batch tokens * steps / wall; ``bubble_fraction`` =
+    1 - sum(stage busy_s deltas) / (pp * wall) — the measured idle
+    share the schedule leaves on the stages.  Best-of-``rounds`` with
+    raw samples (PR 6/7 convention), plus a chaos variant: SIGKILL a
+    mid-pipeline stage mid-epoch — the epoch must complete from the
+    stage's ``__ray_save__`` checkpoint with bounded replay
+    (``stage_restarts`` >= 1) and zero ObjectLostError at the driver."""
+    import tempfile
+
+    import numpy as np
+
+    import ray_tpu as ray
+    from ray_tpu.cluster_utils import Cluster
+
+    pp = 3
+    M = 2 * pp
+    batch, seq = 12, 16
+    steps = 2
+    delay_ms = 120
+    # role "worker": the activation/grad stripe pushes run in the STAGE
+    # ACTOR's process (`_send_piece_range`), not the node agent's serve
+    # loop — pacing the agent (the shuffle row's choice) would leave
+    # the push path free.
+    pace = f"worker:chunk_send:delay-{delay_ms}:1"
+
+    def build_trainer():
+        import jax
+        import optax
+
+        from ray_tpu.models import llama as L
+        from ray_tpu.train.pipeline_actors import PipelineTrainer
+
+        cfg = L.LlamaConfig.tiny(num_layers=pp)  # one layer per stage
+        params = L.init_params(jax.random.PRNGKey(0), cfg)
+        tr = PipelineTrainer(
+            L.make_pipeline_stage_fn(cfg), L.make_pipeline_loss_fn(cfg),
+            L.pipeline_stage_params(params, pp),
+            optimizer=optax.sgd(1e-2), num_microbatches=M,
+            distributed=True)
+        rng = np.random.default_rng(0)
+        tok = rng.integers(0, cfg.vocab_size,
+                           size=(batch, seq + 1)).astype(np.int32)
+        return tr, tok[:, :-1], tok[:, 1:]
+
+    def one_round():
+        c = Cluster(head_num_cpus=0, _system_config={})
+        try:
+            # One CPU per node: the two stage actors are forced onto
+            # DIFFERENT nodes, so every activation/grad hop crosses the
+            # paced link.
+            for _ in range(pp):
+                c.add_node(num_cpus=1, external=True, env_overrides={
+                    "RAY_TPU_CHAOS_NET": pace,
+                    "RAY_TPU_CHAOS_DIR": tempfile.mkdtemp(),
+                })
+            tr, x, t = build_trainer()
+            assert tr.distributed
+            tr.step(x, t)  # warm the per-stage jit caches
+
+            def timed(schedule):
+                busy0 = sum(s["busy_s"] for s in tr.stage_stats())
+                st0 = c.rt.transfer_stats()
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    tr.step(x, t, schedule=schedule)
+                dt = time.perf_counter() - t0
+                busy1 = sum(s["busy_s"] for s in tr.stage_stats())
+                st1 = c.rt.transfer_stats()
+                time.sleep(1.2)  # the pushes counter flushes async
+                st1 = c.rt.transfer_stats()
+                return {
+                    "wall_s": round(dt, 2),
+                    "tok_s": round(batch * seq * steps / dt, 1),
+                    "bubble_fraction": round(
+                        1.0 - (busy1 - busy0) / (pp * dt), 3),
+                    "microbatch_pushes": st1["microbatch_pushes"]
+                    - st0["microbatch_pushes"],
+                }
+
+            fd = timed("fill_drain")
+            ofb = timed("1f1b")
+            tr.shutdown()
+            return fd, ofb
+        finally:
+            c.shutdown()
+
+    def chaos_round():
+        """Mid-epoch SIGKILL of the last (loss) stage while a step's
+        schedule is in flight; unpaced so the row stays quick."""
+        import threading
+
+        rt = ray.init(num_cpus=4, num_tpus=0)
+        try:
+            tr, x, t = build_trainer()
+            losses = [tr.step(x, t)["loss"]]
+            pids = tr.stage_pids()
+            time.sleep(0.5)  # checkpoint message lands
+
+            def killer():
+                time.sleep(0.1)
+                import os
+
+                os.kill(pids[1], 9)
+
+            th = threading.Thread(target=killer)
+            th.start()
+            completed = True
+            try:
+                for _ in range(3):
+                    losses.append(tr.step(x, t)["loss"])
+            except Exception:  # noqa: BLE001 — incl. any ObjectLostError
+                completed = False
+            th.join()
+            time.sleep(1.2)
+            st = rt.transfer_stats()
+            tr.shutdown()
+            return {"completed": completed, "steps": len(losses),
+                    "stage_restarts": st["stage_restarts"]}
+        finally:
+            ray.shutdown()
+
+    pairs = [one_round() for _ in range(rounds)]
+
+    def pick(samples):
+        best = max(samples, key=lambda s: s["tok_s"])
+        return {**best, "samples": samples}
+
+    fd, ofb = pick([p[0] for p in pairs]), pick([p[1] for p in pairs])
+    try:
+        chaos_row = chaos_round()
+    except Exception as e:  # noqa: BLE001 — extra row must not kill A/B
+        chaos_row = {"error": repr(e)}
+
+    out = {"pp": pp, "microbatches": M, "tokens_per_step": batch * seq,
+           "delay_ms": delay_ms, "rounds": rounds,
+           "fill_drain": fd, "1f1b": ofb, "chaos": chaos_row}
+    print(f"  [pipeline_train] 1f1b {ofb['tok_s']} tok/s vs fill_drain "
+          f"{fd['tok_s']} tok/s "
+          f"({ofb['tok_s'] / max(fd['tok_s'], 1e-9):.2f}x), bubble "
+          f"{ofb['bubble_fraction']} vs {fd['bubble_fraction']}; chaos "
+          f"completed={chaos_row.get('completed')} "
+          f"(stage_restarts={chaos_row.get('stage_restarts')})",
+          file=sys.stderr)
+    return out
+
+
+def impala_throughput_bench(iters=4):
+    """Distributed IMPALA row: rollout workers -> aggregator actors ->
+    the learner's host->device double-buffered queue, env-frames/s with
+    the queue's measured occupancy, double-buffering on
+    (``impala_queue_depth=2`` — the h2d of batch t+1 issues while the
+    update for batch t computes) vs off (depth 0: direct per-update
+    transfer), aggregators on in both modes so the only variable is
+    the loader thread.  On CPU ``jnp.asarray`` is a near-free memcpy,
+    so — like the shuffle row's paced pull plane — the shared
+    ``_to_device`` hop is paced with a fixed per-batch delay modeling a
+    real host->accelerator interconnect, applied identically in BOTH
+    modes: depth 2 hides it behind the running update, depth 0 pays it
+    serially, which makes the A/B load-independent."""
+    import numpy as np  # noqa: F401 -- parity with workers
+
+    pace_ms = 15
+
+    def cartpole():
+        import gymnasium
+
+        return gymnasium.make("CartPole-v1")
+
+    def one_mode(depth):
+        import ray_tpu as ray
+        from ray_tpu.rllib import ImpalaConfig
+        from ray_tpu.rllib import impala as impala_mod
+
+        real_to_device = impala_mod._to_device
+
+        def paced_to_device(tm):
+            time.sleep(pace_ms / 1000.0)
+            return real_to_device(tm)
+
+        impala_mod._to_device = paced_to_device
+        ray.init(num_cpus=8, num_tpus=0,
+                 _system_config={"impala_queue_depth": depth})
+        try:
+            config = (ImpalaConfig()
+                      .environment(cartpole)
+                      .rollouts(num_rollout_workers=2,
+                                num_envs_per_worker=2,
+                                rollout_fragment_length=32)
+                      .training(lr=4e-3, num_aggregators=2,
+                                max_batches_per_step=4))
+            algo = config.build()
+            algo.train()  # warm jit + fill the sample pipeline
+            frames = 0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                frames += algo.train()["num_env_steps_sampled"]
+            dt = time.perf_counter() - t0
+            q = (algo._h2d.queue_stats() if algo._h2d is not None
+                 else {"gets": 0, "stalls": 0, "occupancy_avg": 0.0})
+            algo.stop()
+            return {"frames_s": round(frames / dt, 1),
+                    "queue_depth": depth,
+                    "queue_gets": q["gets"],
+                    "queue_stalls": q["stalls"],
+                    "queue_occupancy_avg": round(q["occupancy_avg"], 3)}
+        finally:
+            impala_mod._to_device = real_to_device
+            ray.shutdown()
+
+    def best_of(depth, rounds=3):
+        samples = [one_mode(depth) for _ in range(rounds)]
+        best = max(samples, key=lambda s: s["frames_s"])
+        best["samples_frames_s"] = [s["frames_s"] for s in samples]
+        return best
+
+    on = best_of(2)
+    off = best_of(0)
+    out = {"h2d_pace_ms": pace_ms,
+           "double_buffer_on": on, "double_buffer_off": off}
+    print(f"  [impala_throughput] depth2 {on['frames_s']} frames/s "
+          f"(occupancy {on['queue_occupancy_avg']}, stalls "
+          f"{on['queue_stalls']}) vs depth0 {off['frames_s']} frames/s",
+          file=sys.stderr)
+    return out
+
+
 def elastic_drill_bench():
     """Elastic-pods row: sustained small-task traffic against an
     autoscaled spot slice pool crosses ONE mid-run preemption — drain
@@ -1607,6 +1847,19 @@ def main():
         push_shuffle = {"error": repr(e)}
 
     try:
+        pipeline_train = pipeline_train_bench()
+    except Exception as e:  # noqa: BLE001 — extra row must not kill core
+        print(f"  [pipeline_train] bench failed: {e!r}", file=sys.stderr)
+        pipeline_train = {"error": repr(e)}
+
+    try:
+        impala_throughput = impala_throughput_bench()
+    except Exception as e:  # noqa: BLE001 — extra row must not kill core
+        print(f"  [impala_throughput] bench failed: {e!r}",
+              file=sys.stderr)
+        impala_throughput = {"error": repr(e)}
+
+    try:
         tpu = tpu_bench()
     except Exception as e:  # noqa: BLE001 — device bench must not kill core
         print(f"  [tpu] device bench failed: {e!r}", file=sys.stderr)
@@ -1627,9 +1880,11 @@ def main():
         "elastic_drill": elastic_drill,
         "degraded_link": degraded_link,
         "serve_latency": serve_latency,
+        "push_shuffle": push_shuffle,
         # Last (before the small tpu dict): the round artifact keeps the
         # TAIL of this line, and this round's A/B rows live here.
-        "push_shuffle": push_shuffle,
+        "pipeline_train": pipeline_train,
+        "impala_throughput": impala_throughput,
         "tpu": tpu,
     }))
 

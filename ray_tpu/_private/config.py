@@ -163,6 +163,31 @@ class Config:
     # finalize.  Minimum 2.
     shuffle_merge_fanin: int = 8
 
+    # --- Distributed training (reference: PipeDream SOSP'19 1F1B +
+    # IMPALA ICML'18 decoupled actor/learner).  Master switch for the
+    # distributed training planes: pipeline stages as long-lived
+    # restartable actors exchanging micro-batch activations/grads over
+    # the striped put verbs with the 1F1B schedule driven by the actor
+    # call pipeline (train/pipeline_actors.py), and IMPALA's aggregator
+    # actors + host->TPU double-buffered learner queue (rllib/impala.py).
+    # Off = the byte-identical single-host paths (pipeline_apply in one
+    # process, the per-batch direct learner update) with every new
+    # counter (microbatch_pushes / stage_restarts / learner_queue_stalls)
+    # zero.  Read in WORKER processes too (stage actors push; a trainer
+    # built inside a Trainable must see the driver's switch), so it
+    # rides _worker_config_env. ---
+    distributed_training: bool = True
+    # Default micro-batch count for PipelineTrainer when the caller does
+    # not pass one: 0 = 2 * num_stages (the 1F1B sweet spot — enough
+    # in-flight microbatches to hide the pp-1 fill, bounded stash).
+    pipeline_microbatches: int = 0
+    # Host->device queue depth for IMPALA's learner loader thread (the
+    # MultiGPULearnerThread analog): batch t+1's h2d transfer is issued
+    # while step t computes, up to this many device-resident batches
+    # buffered ahead.  0 disables the loader thread (each update pays
+    # its own h2d on the critical path — the measured A/B baseline).
+    impala_queue_depth: int = 2
+
     # --- Decentralized dispatch (reference: the raylet's lease-based
     # hybrid scheduling, RequestWorkerLease + spillback in
     # local_task_manager.h:58, with task metadata owned by the submitting

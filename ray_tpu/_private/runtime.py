@@ -630,6 +630,19 @@ class Runtime:
         self.shuffle_merges = 0
         self.shuffle_spills = 0
         self.shuffle_hedges = 0
+        # Distributed-training counters (all zero while
+        # distributed_training is off — pinned by tests):
+        # microbatch_pushes = micro-batch activation/grad segments
+        # pipeline stage actors pushed straight into their neighbor
+        # stage's store (never through the head), stage_restarts =
+        # pipeline stage actors restored from a __ray_save__ checkpoint
+        # after a death, learner_queue_stalls = IMPALA learner waits on
+        # an empty host->device batch queue (worker deltas via
+        # xfer_stats, plus the driver-process trainer's own — merged at
+        # transfer_stats time).
+        self.microbatch_pushes = 0
+        self.stage_restarts = 0
+        self.learner_queue_stalls = 0
         # Drain rendezvous: aid -> Event set when the forced
         # ("checkpoint_now", aid) round-trips as an actor_checkpoint;
         # node_id -> [done_event, outcome, deadline_abs] for that
@@ -2643,6 +2656,17 @@ class Runtime:
                 str(self.config.shuffle_partition_bytes_target),
             "RAY_TPU_SHUFFLE_MERGE_FANIN":
                 str(self.config.shuffle_merge_fanin),
+            # Distributed-training knobs: the switch and both tuning
+            # knobs are read wherever the trainer/learner runs — stage
+            # actors push in WORKER processes, and a PipelineTrainer or
+            # Impala built inside a Trainable worker must see the
+            # driver's _system_config.
+            "RAY_TPU_DISTRIBUTED_TRAINING":
+                "1" if self.config.distributed_training else "0",
+            "RAY_TPU_PIPELINE_MICROBATCHES":
+                str(self.config.pipeline_microbatches),
+            "RAY_TPU_IMPALA_QUEUE_DEPTH":
+                str(self.config.impala_queue_depth),
             "RAY_TPU_DECENTRALIZED_DISPATCH":
                 "1" if self.config.decentralized_dispatch else "0",
             "RAY_TPU_LEASE_SLOTS": str(self.config.lease_slots),
@@ -4628,6 +4652,13 @@ class Runtime:
                 self.shuffle_merges += d.get("shuffle_merges", 0)
                 self.shuffle_spills += d.get("shuffle_spills", 0)
                 self.shuffle_hedges += d.get("shuffle_hedges", 0)
+                # Distributed-training deltas from pipeline stage
+                # actors / IMPALA learner workers (zero with the
+                # switch off).
+                self.microbatch_pushes += d.get("microbatch_pushes", 0)
+                self.stage_restarts += d.get("stage_restarts", 0)
+                self.learner_queue_stalls += d.get(
+                    "learner_queue_stalls", 0)
         elif tag == "result":
             self._on_result(worker, msg[1], msg[2], msg[3], msg[4])
         elif tag == "result_batch":
@@ -6442,6 +6473,13 @@ class Runtime:
         shuffle_mod = sys.modules.get("ray_tpu.data.shuffle")
         head_shuf = (shuffle_mod.shuffle_stats() if shuffle_mod is not None
                      else {})
+        # And for the distributed-training planes: the PipelineTrainer
+        # driver and IMPALA's learner-side loader usually ARE this head
+        # process, so their counters live in the train module's
+        # process-local registry, not in any worker delta.
+        train_mod = sys.modules.get("ray_tpu.train.pipeline_actors")
+        head_train = (train_mod.train_stats() if train_mod is not None
+                      else {})
         with self.lock:
             return {
                 "shuffle_pushed_bytes":
@@ -6456,6 +6494,15 @@ class Runtime:
                 "shuffle_hedges":
                     self.shuffle_hedges
                     + head_shuf.get("shuffle_hedges", 0),
+                "microbatch_pushes":
+                    self.microbatch_pushes
+                    + head_train.get("microbatch_pushes", 0),
+                "stage_restarts":
+                    self.stage_restarts
+                    + head_train.get("stage_restarts", 0),
+                "learner_queue_stalls":
+                    self.learner_queue_stalls
+                    + head_train.get("learner_queue_stalls", 0),
                 "suspected_nodes": self.suspected_nodes,
                 "stall_timeouts":
                     self.stall_timeouts + head_net["stall_timeouts"],

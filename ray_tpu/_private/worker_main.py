@@ -409,6 +409,13 @@ class _WorkerRuntime:
         shuffle_mod = sys.modules.get("ray_tpu.data.shuffle")
         if shuffle_mod is not None:
             cur.update(shuffle_mod.shuffle_stats())
+        # Distributed-training counters, same lazy-lookup contract:
+        # present only in workers hosting a pipeline stage actor or an
+        # IMPALA learner (stage restores count here too — the restored
+        # actor's fresh process imports the module in __ray_restore__).
+        train_mod = sys.modules.get("ray_tpu.train.pipeline_actors")
+        if train_mod is not None:
+            cur.update(train_mod.train_stats())
         with self._xfer_lock:
             delta = {}
             for k, v in cur.items():

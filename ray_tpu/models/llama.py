@@ -353,6 +353,69 @@ def forward_pipelined(params: Dict[str, Any], tokens: jax.Array,
     return cst(logits, ("batch", "seq", "vocab")), jnp.zeros((), jnp.float32)
 
 
+def pipeline_stage_params(params: Dict[str, Any],
+                          num_stages: int) -> list:
+    """Stage-sliced construction for the ACTOR pipeline
+    (``train.pipeline_actors``): split the stacked layer params into
+    ``num_stages`` contiguous slices, folding the embedding into stage
+    0 and the final norm + LM head into the last stage — each stage
+    actor then owns exactly its stage's tensors, nothing replicated."""
+    layers = params["layers"]
+    n_layers = next(iter(layers.values())).shape[0]
+    if n_layers % num_stages:
+        raise ValueError(
+            f"{n_layers} layers not divisible by {num_stages} stages")
+    per = n_layers // num_stages
+    out = []
+    for s in range(num_stages):
+        sp: Dict[str, Any] = {
+            "layers": {k: v[s * per:(s + 1) * per]
+                       for k, v in layers.items()}}
+        if s == 0:
+            sp["embed"] = params["embed"]
+        if s == num_stages - 1:
+            sp["final_norm"] = params["final_norm"]
+            sp["lm_head"] = params["lm_head"]
+        out.append(sp)
+    return out
+
+
+def make_pipeline_stage_fn(cfg: LlamaConfig):
+    """The uniform per-stage callable for ``train.pipeline_actors``:
+    embeds on the stage holding ``embed`` (its input is then raw
+    tokens), scans the stage's layer slice, and projects to logits on
+    the stage holding ``lm_head``.  Key presence is trace-time static,
+    so each stage jits to exactly its own program."""
+
+    def stage_fn(sp, x):
+        layer_fn = _make_layer_fn(cfg, None, None)
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
+        if "embed" in sp:
+            x = jnp.take(sp["embed"], x, axis=0).astype(cfg.dtype)
+        (x, _), _ = jax.lax.scan(
+            layer_fn, (x, jnp.zeros((), jnp.float32)), sp["layers"])
+        if "lm_head" in sp:
+            x = rms_norm(x, sp["final_norm"])
+            x = (x @ sp["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+        return x
+
+    return stage_fn
+
+
+def make_pipeline_loss_fn(cfg: LlamaConfig):
+    """Next-token cross-entropy over the last stage's logits — the
+    same mean-NLL ``loss_fn`` computes, as a ``(logits, targets)``
+    pair for the actor pipeline's loss stage."""
+
+    def pipeline_loss(logits, targets):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    return pipeline_loss
+
+
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
             cfg: LlamaConfig, *, mesh: Optional[Mesh] = None,
             rules: Optional[LogicalAxisRules] = None,

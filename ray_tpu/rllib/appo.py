@@ -122,9 +122,11 @@ class APPO(Impala):
         self._target_fwd = jax.jit(target_fwd)
         w = self.learner_group.get_weights()
         self.workers.sync_weights(w)
-        self._inflight = {
-            worker.sample.remote(cfg.rollout_fragment_length): i
-            for i, worker in enumerate(self.workers.workers)}
+        from ray_tpu.remote_function import _bulk_submit
+        sample_futs = _bulk_submit([
+            (worker.sample, (cfg.rollout_fragment_length,), None)
+            for worker in self.workers.workers])
+        self._inflight = {fut: i for i, fut in enumerate(sample_futs)}
 
     def _augment_with_target(self, tm: Dict[str, Any]) -> Dict[str, Any]:
         t, b = tm[ACTIONS].shape
@@ -157,9 +159,7 @@ class APPO(Impala):
                 flat = ray.get(fut)
             except Exception:
                 worker = self.workers.recreate(idx)
-                worker.set_weights.remote(self.learner_group.get_weights())
-                self._inflight[worker.sample.remote(
-                    cfg.rollout_fragment_length)] = idx
+                self._resubmit(worker, idx)
                 continue
             tm = self._to_time_major(flat, cfg.rollout_fragment_length)
             tm = self._augment_with_target(tm)
@@ -172,9 +172,7 @@ class APPO(Impala):
                 self._target_params = jax.tree.map(
                     jnp.copy, self.learner_group.get_weights())
                 self._updates_since_target_sync = 0
-            worker.set_weights.remote(self.learner_group.get_weights())
-            self._inflight[worker.sample.remote(
-                cfg.rollout_fragment_length)] = idx
+            self._resubmit(worker, idx)
         returns = self.workers.episode_returns()
         if returns:
             metrics["episode_reward_mean"] = float(np.mean(returns))

@@ -2,17 +2,27 @@
 
 Reference: ``rllib/algorithms/impala/impala.py:474`` (``training_step``
 :616): workers sample asynchronously; batches flow to the learner without
-waiting for the fleet; staleness is corrected by V-trace.  The reference's
-CPU->GPU loader threads (``make_learner_thread`` :433,
-``multi_gpu_learner_thread.py``) have no equivalent here — one host->TPU
-``device_put`` per update and XLA's async dispatch already overlap transfer
-with compute.  Weights flow back per-worker on batch receipt (the
-broadcast-interval pattern of :571).
+waiting for the fleet; staleness is corrected by V-trace.  Weights flow
+back per-worker on batch receipt (the broadcast-interval pattern of :571).
+
+Distributed mode (``num_aggregators > 0`` and the
+``distributed_training`` master switch): rollout batches flow
+worker -> aggregator over the striped data plane (the sample ObjectRef is
+passed as an argument, so only the descriptor crosses the control plane),
+aggregators reshape to time-major off the driver, and the learner feeds
+from a host->TPU double-buffered queue — the
+``multi_gpu_learner_thread.py`` analog: a loader thread issues the h2d
+transfer of batch t+1 while the update for batch t computes.  Queue depth
+is ``impala_queue_depth``; a blocking get on an empty queue counts a
+``learner_queue_stalls``.  With the switch off the legacy path below runs
+byte-identically and every new counter stays zero.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import queue
+import threading
+from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +30,8 @@ import numpy as np
 import optax
 
 import ray_tpu as ray
+from ray_tpu.remote_function import _bulk_submit
+from ray_tpu.train.pipeline_actors import active_config
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.models import ActorCriticMLP
@@ -54,6 +66,91 @@ def impala_loss(params, module, batch, *, gamma: float = 0.99,
                   "entropy": entropy}
 
 
+def _to_time_major(flat: SampleBatch, frag: int) -> Dict[str, Any]:
+    """Worker batches concatenate per-env fragments of length ``frag``;
+    reshape (n*frag, ...) -> (frag, n, ...) time-major."""
+    n = len(flat) // frag
+    out = {}
+    for k in (OBS, ACTIONS, REWARDS, DONES, LOGP):
+        v = flat[k][: n * frag]
+        out[k] = np.moveaxis(
+            v.reshape(n, frag, *v.shape[1:]), 0, 1)
+    next_obs = flat[NEXT_OBS][: n * frag].reshape(
+        n, frag, -1)
+    out["bootstrap_obs"] = next_obs[:, -1, :]
+    return out
+
+
+@ray.remote
+class _BatchAggregator:
+    """Off-driver batch prep (reference: IMPALA's aggregation workers,
+    ``impala.py`` aggregator actors).  The sample ObjectRef arrives as an
+    argument, so the payload flows rollout worker -> aggregator over the
+    data plane and the driver only ever touches the time-major result."""
+
+    def aggregate(self, frag: int, flat: SampleBatch) -> Dict[str, Any]:
+        return _to_time_major(flat, frag)
+
+
+def _to_device(tm: Dict[str, Any]) -> Dict[str, Any]:
+    """Host->device transfer of one time-major batch.  Both learner
+    paths (queued and direct) route through this single hop so the only
+    variable between ``impala_queue_depth`` settings is overlap, never
+    the transfer itself."""
+    return {k: jnp.asarray(v) for k, v in tm.items()}
+
+
+class _HostToDeviceQueue:
+    """``multi_gpu_learner_thread.py`` analog: a daemon loader thread
+    moves time-major host batches onto the device so the h2d transfer of
+    batch t+1 is in flight while the learner update for batch t computes.
+    ``depth`` bounds host batches awaiting transfer; a blocking ``get``
+    on an empty device queue counts one ``learner_queue_stalls``."""
+
+    def __init__(self, depth: int):
+        self._in: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._out: "queue.Queue" = queue.Queue()
+        self._gets = 0
+        self._stalls = 0
+        self._occupancy_sum = 0
+        self._thread = threading.Thread(
+            target=self._loader, name="impala-h2d", daemon=True)
+        self._thread.start()
+
+    def _loader(self):
+        while True:
+            tm = self._in.get()
+            if tm is None:
+                return
+            # The h2d copy overlaps whatever update is currently
+            # running on the caller thread.
+            self._out.put(_to_device(tm))
+
+    def put(self, tm: Dict[str, Any]):
+        self._in.put(tm)
+
+    def get(self) -> Dict[str, Any]:
+        self._gets += 1
+        self._occupancy_sum += self._out.qsize()
+        if self._out.empty():
+            self._stalls += 1
+            from ray_tpu.train.pipeline_actors import note
+            note("learner_queue_stalls")
+        return self._out.get()
+
+    def queue_stats(self) -> Dict[str, float]:
+        return {
+            "gets": self._gets,
+            "stalls": self._stalls,
+            "occupancy_avg": (self._occupancy_sum / self._gets
+                              if self._gets else 0.0),
+        }
+
+    def stop(self):
+        self._in.put(None)
+        self._thread.join(timeout=5.0)
+
+
 class ImpalaConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
@@ -64,6 +161,9 @@ class ImpalaConfig(AlgorithmConfig):
         self.grad_clip = 40.0
         self.rollout_fragment_length = 50
         self.max_batches_per_step = 8
+        # > 0 engages the distributed path: off-driver time-major prep +
+        # the h2d double-buffer (gated by cfg.distributed_training).
+        self.num_aggregators = 0
 
     @property
     def algo_class(self):
@@ -114,27 +214,50 @@ class Impala(Algorithm):
             make_learner, num_learners=cfg.num_learners)
         w = self.learner_group.get_weights()
         self.workers.sync_weights(w)
-        # Kick off the async pipeline: one outstanding sample per worker.
-        self._inflight = {
-            worker.sample.remote(cfg.rollout_fragment_length): i
-            for i, worker in enumerate(self.workers.workers)}
+        self._aggregators: List[Any] = []
+        self._h2d = None
+        sys_cfg = active_config()
+        if sys_cfg.distributed_training and \
+                getattr(cfg, "num_aggregators", 0) > 0:
+            self._aggregators = [
+                _BatchAggregator.options(num_cpus=1).remote()
+                for _ in range(cfg.num_aggregators)]
+            if sys_cfg.impala_queue_depth > 0:
+                self._h2d = _HostToDeviceQueue(
+                    sys_cfg.impala_queue_depth)
+        # Kick off the async pipeline: one outstanding sample per worker —
+        # the whole wave goes out in one dispatch pass.
+        sample_futs = _bulk_submit([
+            (worker.sample, (cfg.rollout_fragment_length,), None)
+            for worker in self.workers.workers])
+        self._inflight = {self._chain(fut, i): i
+                          for i, fut in enumerate(sample_futs)}
+
+    def _chain(self, sample_fut, idx: int):
+        """Route a sample future through an aggregator (payload flows
+        worker -> aggregator over the data plane); identity when the
+        distributed path is off."""
+        if not getattr(self, "_aggregators", None):
+            return sample_fut
+        agg = self._aggregators[idx % len(self._aggregators)]
+        return agg.aggregate.remote(
+            self.algo_config.rollout_fragment_length, sample_fut)
+
+    def _resubmit(self, worker, idx: int):
+        """Weight refresh + resample for one worker in one dispatch pass."""
+        _, s_ref = _bulk_submit([
+            (worker.set_weights, (self.learner_group.get_weights(),), None),
+            (worker.sample, (self.algo_config.rollout_fragment_length,),
+             None)])
+        self._inflight[self._chain(s_ref, idx)] = idx
 
     def _to_time_major(self, flat: SampleBatch, frag: int) -> Dict[str, Any]:
-        """Worker batches concatenate per-env fragments of length ``frag``;
-        reshape (n*frag, ...) -> (frag, n, ...) time-major."""
-        n = len(flat) // frag
-        out = {}
-        for k in (OBS, ACTIONS, REWARDS, DONES, LOGP):
-            v = flat[k][: n * frag]
-            out[k] = np.moveaxis(
-                v.reshape(n, frag, *v.shape[1:]), 0, 1)
-        next_obs = flat[NEXT_OBS][: n * frag].reshape(
-            n, frag, -1)
-        out["bootstrap_obs"] = next_obs[:, -1, :]
-        return out
+        return _to_time_major(flat, frag)
 
     def training_step(self) -> Dict[str, Any]:
         cfg: ImpalaConfig = self.algo_config
+        if getattr(self, "_aggregators", None):
+            return self._training_step_distributed(cfg)
         metrics: Dict[str, Any] = {}
         steps = 0
         processed = 0
@@ -152,18 +275,58 @@ class Impala(Algorithm):
                 # Rebuild the dead worker before resubmitting — resubmitting
                 # to a dead handle busy-spins on instantly-errored futures.
                 worker = self.workers.recreate(idx)
-                worker.set_weights.remote(self.learner_group.get_weights())
-                self._inflight[worker.sample.remote(
-                    cfg.rollout_fragment_length)] = idx
+                self._resubmit(worker, idx)
                 continue
             tm = self._to_time_major(flat, cfg.rollout_fragment_length)
             metrics = self.learner_group.update(SampleBatch(tm))
             steps += len(flat)
             processed += 1
             # per-worker weight refresh, then immediately resample (async)
-            worker.set_weights.remote(self.learner_group.get_weights())
-            self._inflight[worker.sample.remote(
-                cfg.rollout_fragment_length)] = idx
+            self._resubmit(worker, idx)
+        returns = self.workers.episode_returns()
+        if returns:
+            metrics["episode_reward_mean"] = float(np.mean(returns))
+        metrics["num_env_steps_sampled"] = steps
+        return metrics
+
+    def _training_step_distributed(self, cfg: ImpalaConfig) -> Dict[str, Any]:
+        """Aggregator-fed variant: the driver receives time-major batches
+        (prepped off-driver) and keeps one batch in flight through the h2d
+        queue so transfer of batch t+1 overlaps the update of batch t."""
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        processed = 0
+        buffered = 0
+        while processed < cfg.max_batches_per_step and self._inflight:
+            done, _ = ray.wait(list(self._inflight), num_returns=1,
+                               timeout=30.0)
+            if not done:
+                break
+            fut = done[0]
+            idx = self._inflight.pop(fut)
+            worker = self.workers.workers[idx]
+            try:
+                tm = ray.get(fut)
+            except Exception:
+                worker = self.workers.recreate(idx)
+                self._resubmit(worker, idx)
+                continue
+            steps += tm[ACTIONS].shape[0] * tm[ACTIONS].shape[1]
+            processed += 1
+            if self._h2d is not None:
+                self._h2d.put(tm)
+                buffered += 1
+                if buffered > 1:
+                    metrics = self.learner_group.update(
+                        SampleBatch(self._h2d.get()))
+                    buffered -= 1
+            else:
+                metrics = self.learner_group.update(
+                    SampleBatch(_to_device(tm)))
+            self._resubmit(worker, idx)
+        while buffered > 0:  # drain the double-buffer
+            metrics = self.learner_group.update(SampleBatch(self._h2d.get()))
+            buffered -= 1
         returns = self.workers.episode_returns()
         if returns:
             metrics["episode_reward_mean"] = float(np.mean(returns))
@@ -178,4 +341,11 @@ class Impala(Algorithm):
         self.workers.sync_weights(self.learner_group.get_weights())
 
     def cleanup(self):
+        if getattr(self, "_h2d", None) is not None:
+            self._h2d.stop()
+        for agg in getattr(self, "_aggregators", []):
+            try:
+                ray.kill(agg)
+            except Exception:
+                pass
         self.workers.stop()

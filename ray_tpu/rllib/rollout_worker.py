@@ -171,11 +171,12 @@ class WorkerSet:
 
     def sample_sync(self, steps_per_worker: int):
         """synchronous_parallel_sample (reference:
-        rllib/execution/rollout_ops.py:21) with worker recreation."""
-        futs = {w.sample.remote(steps_per_worker): (i, w)
-                for i, w in enumerate(self._workers)}
+        rllib/execution/rollout_ops.py:21) with worker recreation.  The
+        whole collection wave goes out in one dispatch pass."""
+        futs = _bulk_submit([(w.sample, (steps_per_worker,), None)
+                             for w in self._workers])
         out = []
-        for fut, (i, w) in list(futs.items()):
+        for i, fut in enumerate(futs):
             try:
                 out.append(ray.get(fut))
             except Exception:
@@ -186,9 +187,11 @@ class WorkerSet:
 
     def episode_returns(self) -> List[float]:
         rets = []
-        for w in self._workers:
+        futs = _bulk_submit([(w.episode_returns, (), None)
+                             for w in self._workers])
+        for fut in futs:
             try:
-                rets.extend(ray.get(w.episode_returns.remote()))
+                rets.extend(ray.get(fut))
             except Exception:
                 pass
         return rets

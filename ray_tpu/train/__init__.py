@@ -22,9 +22,11 @@ from ray_tpu.train.trainer import (
     JaxTrainer,
 )
 from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.train.pipeline_actors import PipelineStage, PipelineTrainer
 
 __all__ = [
     "TrainState", "init_train_state", "make_train_step", "default_optimizer",
     "Backend", "JaxConfig", "BackendExecutor", "TrainingFailedError",
     "BaseTrainer", "DataParallelTrainer", "JaxTrainer", "WorkerGroup",
+    "PipelineStage", "PipelineTrainer",
 ]

@@ -442,6 +442,37 @@ def test_shuffle_stats_lock_convention(checker, monkeypatch):
     checker.assert_acyclic()
 
 
+def test_train_stats_lock_convention(checker, monkeypatch):
+    """train/pipeline_actors._STATS_LOCK's documented convention: an
+    independent LEAF guarding only the process-local training counter
+    dict read by ``train_stats()`` (the xfer_stats flusher /
+    transfer_stats merge); never held across serialization, a push, or
+    any wire call — zero outgoing edges across the note/snapshot paths."""
+    from ray_tpu.train import pipeline_actors as _pa
+
+    monkeypatch.setattr(_pa, "_STATS_LOCK", threading.Lock())
+    monkeypatch.setattr(_pa, "_STATS", {
+        "microbatch_pushes": 0, "stage_restarts": 0,
+        "learner_queue_stalls": 0})
+    assert isinstance(_pa._STATS_LOCK, lockcheck._LockProxy)
+    _pa.note("microbatch_pushes", 3)
+    _pa.note("stage_restarts")
+    got = []
+    reader = threading.Thread(
+        target=lambda: got.append(_pa.train_stats()))
+    reader.start()
+    _pa.note("learner_queue_stalls")
+    reader.join(timeout=5)
+    assert got and got[0]["microbatch_pushes"] == 3
+    assert _pa.train_stats()["stage_restarts"] == 1
+    stats_site = _pa._STATS_LOCK._site
+    edges = checker.edges()
+    assert edges.get(stats_site, set()) == set(), (
+        f"a lock was acquired while holding the training-stats lock: "
+        f"{edges.get(stats_site)}")
+    checker.assert_acyclic()
+
+
 def test_lineage_table_lock_is_leaf(checker):
     """recovery.LineageTable._lock's documented convention: an
     independent LEAF.  Both owners take it while already holding their

@@ -1,0 +1,305 @@
+"""Distributed pipeline-parallel training tests.
+
+Pins the ISSUE 18 acceptance criteria: the distributed 1F1B schedule
+over stage actors matches ``parallel.pipeline.pipeline_apply`` (and the
+single-host fallback) BITWISE on integer-valued float32 training; a
+killed mid-pipeline stage restores from its ``__ray_save__`` checkpoint
+with bounded loss-step replay and zero object loss at the driver; the
+``distributed_training`` master switch off runs the byte-identical
+single-host path with every new counter zero.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.train.pipeline_actors import (
+    PipelineTrainer, _split_microbatches, train_stats,
+)
+
+
+# Module-level so cloudpickled actor ctors resolve them by reference.
+def _stage_fn(sp, x):
+    import jax
+
+    def layer(carry, w):
+        return (carry @ w), None
+
+    y, _ = jax.lax.scan(layer, x, sp["w"])
+    return y
+
+
+def _loss_fn(y, t):
+    import jax.numpy as jnp
+
+    # Mean over elements; with integer-valued data every term is an
+    # exact small rational (denominator a power of two) -> bitwise-
+    # reproducible across summation orders.
+    return jnp.sum(y - t) / y.size
+
+
+def _int_data(seed=0, D=4, B=8, L=4):
+    rng = np.random.default_rng(seed)
+    w = rng.integers(-2, 3, size=(L, D, D)).astype(np.float32)
+    x = rng.integers(-2, 3, size=(B, D)).astype(np.float32)
+    t = rng.integers(-2, 3, size=(B, D)).astype(np.float32)
+    return w, x, t
+
+
+def _sgd_trainer(w, num_microbatches=4, **kw):
+    import optax
+
+    return PipelineTrainer(
+        _stage_fn, _loss_fn, [{"w": w[:2]}, {"w": w[2:]}],
+        optimizer=optax.sgd(1.0), num_microbatches=num_microbatches, **kw)
+
+
+def test_1f1b_schedule_shape_and_stash_bound():
+    """Warmup is min(pp-1-s, M) forwards; each B(i) follows F(i); the
+    live activation stash never exceeds pp entries."""
+    w, _, _ = _int_data()
+    tr = _sgd_trainer(w, num_microbatches=6, distributed=False)
+    tr._pp = 4  # schedule shape is pure arithmetic over (pp, M, s)
+    for s in range(4):
+        seq = tr._stage_sched(s)
+        warmup = 0
+        for kind, _ in seq:
+            if kind != "F":
+                break
+            warmup += 1
+        # Leading forward run = warmup forwards plus the first steady-
+        # state forward (1F1B pairs start with F).
+        assert warmup == min(min(4 - 1 - s, 6) + 1, 6)
+        assert len(seq) == 2 * 6
+        live, high = 0, 0
+        done_f, done_b = set(), set()
+        for kind, i in seq:
+            if kind == "F":
+                done_f.add(i)
+                live += 1
+            else:
+                assert i in done_f, "backward before its forward"
+                done_b.add(i)
+                live -= 1
+            high = max(high, live)
+        assert done_f == done_b == set(range(6))
+        assert high <= 4, f"stage {s}: {high} live stashes > pp"
+
+
+def test_distributed_1f1b_bitwise_vs_pipeline_apply(ray_start_regular):
+    """The acceptance pin: distributed 1F1B loss and per-stage grads are
+    bitwise-equal to ``pipeline_apply`` on one host (pp=2 mesh) and to
+    the single-host fallback, for integer-valued float32 weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.parallel import MeshConfig, make_mesh
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    w, x, t = _int_data()
+    M = 4
+
+    tr = _sgd_trainer(w, num_microbatches=M)
+    assert tr.distributed
+    before = tr.get_stage_params()
+    metrics = tr.step(x, t)
+    after = tr.get_stage_params()
+    # sgd(lr=1.0): the applied update IS the mean micro-batch gradient.
+    dist_grads = [b["w"] - a["w"] for b, a in zip(before, after)]
+    tr.shutdown()
+
+    # Reference 1: pipeline_apply (in-XLA GPipe over the pp mesh axis).
+    mesh = make_mesh(MeshConfig(dp=1, pp=2), devices=jax.devices()[:2])
+    stacked = {"w": w.reshape(2, 2, *w.shape[1:])}
+
+    def ref_loss(sp):
+        y = pipeline_apply(_stage_fn, sp, jnp.asarray(x), mesh=mesh,
+                           num_microbatches=M)
+        return _loss_fn(y, jnp.asarray(t))
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(stacked)
+    assert np.float32(metrics["loss"]) == np.float32(ref_l)
+    for s in range(2):
+        np.testing.assert_array_equal(dist_grads[s],
+                                      np.asarray(ref_g["w"][s]))
+
+    # Reference 2: the single-host fallback (master-switch-off path).
+    tr2 = _sgd_trainer(w, num_microbatches=M, distributed=False)
+    m2 = tr2.step(x, t)
+    assert np.float32(m2["loss"]) == np.float32(ref_l)
+    for a, b in zip(after, tr2.get_stage_params()):
+        np.testing.assert_array_equal(a["w"], b["w"])
+
+    # Counters flowed worker -> head: (pp-1) * M activations forward
+    # plus (pp-1) * M grads backward.
+    time.sleep(1.2)
+    st = ray_start_regular.transfer_stats()
+    assert st["microbatch_pushes"] >= 2 * M
+    assert st["stage_restarts"] == 0
+    assert st["learner_queue_stalls"] == 0
+
+
+def test_transfer_stats_has_training_counters(ray_start_regular):
+    st = ray_start_regular.transfer_stats()
+    for k in ("microbatch_pushes", "stage_restarts",
+              "learner_queue_stalls"):
+        assert st[k] == 0
+
+
+def test_switch_off_is_single_host_with_zero_counters():
+    """Master switch off: PipelineTrainer falls back to the single-host
+    path, the knobs ride _system_config -> _worker_config_env into
+    spawned workers, and every new counter stays zero (pinned)."""
+    rt = ray.init(num_cpus=4, _system_config={
+        "distributed_training": False,
+        "pipeline_microbatches": 6,
+        "impala_queue_depth": 0,
+    })
+    try:
+        @ray.remote
+        def probe():
+            import os
+
+            return (os.environ.get("RAY_TPU_DISTRIBUTED_TRAINING"),
+                    os.environ.get("RAY_TPU_PIPELINE_MICROBATCHES"),
+                    os.environ.get("RAY_TPU_IMPALA_QUEUE_DEPTH"))
+
+        assert ray.get(probe.remote(), timeout=60) == ("0", "6", "0")
+
+        w, x, t = _int_data()
+        tr = _sgd_trainer(w, num_microbatches=0)  # 0 -> config knob (6)
+        assert not tr.distributed
+        assert tr.num_microbatches == 6
+        # 6 microbatches don't divide batch 8 -> use 4 explicitly.
+        tr = _sgd_trainer(w)
+        tr.step(x, t)
+        time.sleep(1.0)
+        st = rt.transfer_stats()
+        assert st["microbatch_pushes"] == 0
+        assert st["stage_restarts"] == 0
+        assert st["learner_queue_stalls"] == 0
+    finally:
+        ray.shutdown()
+
+
+@pytest.mark.slow
+def test_inflight_replay_after_stage_kill(ray_start_regular):
+    """Kill the last stage between steps: the actor restores from its
+    ``__ray_save__`` checkpoint, in-flight calls replay in order, and
+    the training trajectory is bitwise-identical to an uninterrupted
+    distributed run."""
+    w, x, t = _int_data()
+    tr = _sgd_trainer(w)
+    losses = [tr.step(x, t)["loss"] for _ in range(2)]
+    pids = tr.stage_pids()
+    time.sleep(0.5)  # let the post-call checkpoint message land
+    os.kill(pids[1], 9)
+    losses += [tr.step(x, t)["loss"] for _ in range(2)]
+    final = tr.get_stage_params()
+    tr.shutdown()
+
+    tr2 = _sgd_trainer(w)
+    ref = [tr2.step(x, t)["loss"] for _ in range(4)]
+    assert [np.float32(v) for v in losses] == [np.float32(v) for v in ref]
+    for a, b in zip(final, tr2.get_stage_params()):
+        np.testing.assert_array_equal(a["w"], b["w"])
+    tr2.shutdown()
+
+    time.sleep(1.2)
+    assert ray_start_regular.transfer_stats()["stage_restarts"] >= 1
+
+
+@pytest.mark.slow
+def test_chaos_mid_epoch_kill_bounded_replay(ray_start_regular):
+    """Chaos drill: kill a mid-pipeline stage WHILE a step is running,
+    mid-epoch.  The epoch completes (bounded re-drive, idempotent
+    apply_grads), no ObjectLostError reaches the driver, and the
+    trajectory matches an uninterrupted distributed run bitwise."""
+    w, x, t = _int_data()
+    tr = _sgd_trainer(w)
+    losses = [tr.step(x, t)["loss"] for _ in range(2)]
+    pids = tr.stage_pids()
+    time.sleep(0.5)
+
+    def killer():
+        time.sleep(0.15)
+        os.kill(pids[1], 9)
+
+    th = threading.Thread(target=killer)
+    th.start()
+    # The kill lands while this step's schedule is in flight.
+    losses.append(tr.step(x, t)["loss"])
+    th.join()
+    losses.append(tr.step(x, t)["loss"])
+    stats = tr.stage_stats()
+    assert [s["applied_step"] for s in stats] == [3, 3]
+    assert all(s["stash"] == 0 for s in stats)
+    final = tr.get_stage_params()
+    tr.shutdown()
+
+    tr2 = _sgd_trainer(w)
+    ref = [tr2.step(x, t)["loss"] for _ in range(4)]
+    assert [np.float32(v) for v in losses] == [np.float32(v) for v in ref]
+    for a, b in zip(final, tr2.get_stage_params()):
+        np.testing.assert_array_equal(a["w"], b["w"])
+    tr2.shutdown()
+
+    time.sleep(1.2)
+    st = ray_start_regular.transfer_stats()
+    assert st["stage_restarts"] >= 1
+
+
+def test_fill_drain_schedule_matches_1f1b(ray_start_regular):
+    """The bench baseline computes the same step: fill/drain wave
+    barriers produce bitwise-identical grads to 1F1B."""
+    w, x, t = _int_data(seed=3)
+    tr = _sgd_trainer(w)
+    m1 = tr.step(x, t, schedule="fill_drain")
+    p_fd = tr.get_stage_params()
+    tr.shutdown()
+    tr2 = _sgd_trainer(w)
+    m2 = tr2.step(x, t, schedule="1f1b")
+    assert np.float32(m1["loss"]) == np.float32(m2["loss"])
+    for a, b in zip(p_fd, tr2.get_stage_params()):
+        np.testing.assert_array_equal(a["w"], b["w"])
+    tr2.shutdown()
+
+
+def test_split_microbatches_rejects_ragged():
+    with pytest.raises(ValueError):
+        _split_microbatches(np.zeros((7, 3)), 2)
+
+
+def test_llama_pipeline_stage_helpers():
+    """models.llama pipeline helpers: stage splitting covers every
+    layer once; stage_fn composition equals the monolithic forward."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama as L
+
+    cfg = L.LlamaConfig.tiny()
+    params = L.init_params(jax.random.PRNGKey(0), cfg)
+    sps = L.pipeline_stage_params(params, 2)
+    assert "embed" in sps[0] and "lm_head" in sps[1]
+    assert "embed" not in sps[1] and "lm_head" not in sps[0]
+    stage_fn = L.make_pipeline_stage_fn(cfg)
+    tok = jnp.asarray(
+        (np.arange(2 * 8).reshape(2, 8) % cfg.vocab_size).astype(np.int32))
+    y = tok
+    for sp in sps:
+        y = stage_fn(sp, y)
+    ref_logits, _ = L.forward(params, tok, cfg)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref_logits),
+                               rtol=2e-5, atol=2e-5)
+    targets = (tok + 1) % cfg.vocab_size
+    loss = L.make_pipeline_loss_fn(cfg)(y, targets)
+    _, ref_metrics = L.loss_fn(params, {"inputs": tok, "targets": targets},
+                               cfg)
+    np.testing.assert_allclose(np.asarray(loss),
+                               np.asarray(ref_metrics["loss"]),
+                               rtol=2e-5, atol=2e-5)
