@@ -255,6 +255,33 @@ class Config:
     # Default quiet period before a deployment downscales (an explicit
     # autoscaling_config downscale_delay_s overrides it per deployment).
     serve_downscale_delay_s: float = 5.0
+    # --- Disaggregated serving (reference: DistServe OSDI'24 /
+    # Splitwise ISCA'24). ---
+    # Master switch for the prefill/decode pool split: a capable
+    # deployment (replicas exporting prefill_export / disagg_generate)
+    # is deployed as two pools behind one logical name — prefill
+    # replicas run prompt-only steps and hand the finished KV block
+    # chain to a decode replica as a segment image streamed over the
+    # reserve_put/put_range data plane.  Off = the byte-identical
+    # monolithic engine: one pool, prefill interleaved with decode,
+    # every disaggregation counter (kv_chains_* /
+    # kv_chain_bytes_streamed / router_prefix_*) stays zero.  Read in
+    # the REPLICA and PROXY processes (rides _worker_config_env).
+    disaggregated_serving: bool = False
+    # Stripe threshold for streamed KV chains: a chain segment larger
+    # than this is striped across put-pool connections (put_range),
+    # smaller ones go single-stream.  Chains are typically much larger
+    # than generic task args, so this defaults lower than
+    # object_put_stripe_threshold.  Read wherever a prefill replica
+    # pushes (rides _worker_config_env).
+    kv_stream_stripe_threshold: int = 1 << 18
+    # Prefix-affinity routing on top of power-of-two-choices: handles
+    # score prefill replicas by the longest prompt-chunk chain they
+    # recently served (route to where the PrefixCache already holds the
+    # blocks; p2c on miss).  Only meaningful with
+    # disaggregated_serving on — all router_prefix_* counters stay
+    # zero when the split is off.
+    prefix_affinity: bool = True
 
     # Seconds a worker may sit idle before the pool reaps it (reference:
     # idle worker killing in worker_pool.cc).

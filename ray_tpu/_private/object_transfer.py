@@ -860,7 +860,8 @@ class ObjectPusher(_PoolHost):
                         else _cfg.object_put_stripe_threshold)
 
     def push(self, store_id: str, addr: str, oid_bin: bytes, meta,
-             buffers, caps: Tuple[str, ...] = ()):
+             buffers, caps: Tuple[str, ...] = (),
+             stripe_threshold: Optional[int] = None):
         """Push one serialized value (``meta`` + out-of-band buffer
         views) into ``store_id``'s store; returns ``(kind, ident,
         total)`` — kind ``"shm"``/``"spilled"``, ident the segment name
@@ -869,13 +870,26 @@ class ObjectPusher(_PoolHost):
         PutUnsupportedError (without any wire traffic) when the peer
         does not advertise the put verbs.
 
+        The put verbs double as the serving tier's chain-handoff wire
+        protocol: a prefill replica streams a finished KV block chain
+        (contiguous block pages + pickled block table, laid out by
+        ``segment_layout``) into the decode replica's node store with
+        exactly this ``reserve_put`` → ``put_range``* → ``commit_put``
+        sequence, and the decode side attaches the committed segment by
+        the returned ident.  ``stripe_threshold`` overrides the pusher's
+        configured stripe cutover for one call — chain images are
+        typically much larger than task args, so that path stripes
+        earlier (``kv_stream_stripe_threshold``).
+
         Failure detection mirrors the pull side: attempts run under the
         zero-progress stall deadline and retry with backoff+jitter; a
         retry's fresh ``reserve_put`` is safe because the evicted
         reserving connection's close already triggered the server-side
-        abort cleanup (the backoff gives it time to land).  Exhaustion
-        raises NetTimeoutError — every caller already treats any push
-        failure as "fall back to the legacy put_parts path"."""
+        abort cleanup (the backoff gives it time to land) — the same
+        cleanup that aborts a half-received chain when a prefill
+        replica dies mid-stream.  Exhaustion raises NetTimeoutError —
+        every caller already treats any push failure as "fall back to
+        the legacy put_parts path"."""
         if not peer_accepts_puts(caps):
             raise PutUnsupportedError(
                 f"peer {store_id} does not speak the put verbs")
@@ -893,11 +907,11 @@ class ObjectPusher(_PoolHost):
                    for off, b in zip(offsets, buffers)]
         return self._run_with_net_retries(
             lambda: self._push_attempt(store_id, addr, oid_bin, pieces,
-                                       total),
+                                       total, stripe=stripe_threshold),
             f"push of {oid_bin.hex()[:12]} to {store_id}")
 
     def _push_attempt(self, store_id: str, addr: str, oid_bin: bytes,
-                      pieces, total: int):
+                      pieces, total: int, stripe: Optional[int] = None):
         pool = self._pool_for(store_id, addr)
         conn = pool.acquire()
         self._arm(conn)
@@ -909,7 +923,8 @@ class ObjectPusher(_PoolHost):
             if reply[0] != "ok":
                 raise OSError(f"put refused by {store_id}: {reply!r}")
             name = reply[1]
-            stripe = self._stripe
+            if stripe is None:
+                stripe = self._stripe
             try:
                 boundary = False
                 if stripe > 0 and total > stripe:

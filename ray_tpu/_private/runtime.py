@@ -2692,6 +2692,17 @@ class Runtime:
                 str(self.config.serve_metric_lookback_s),
             "RAY_TPU_SERVE_DOWNSCALE_DELAY_S":
                 str(self.config.serve_downscale_delay_s),
+            # Disaggregated serving: the split switch is read by the
+            # controller (pool twin deploys), replicas (prefill-only /
+            # chain-import step paths) and handles/proxies (affinity
+            # routing); the stripe threshold wherever a prefill replica
+            # pushes a chain.
+            "RAY_TPU_DISAGGREGATED_SERVING":
+                "1" if self.config.disaggregated_serving else "0",
+            "RAY_TPU_KV_STREAM_STRIPE_THRESHOLD":
+                str(self.config.kv_stream_stripe_threshold),
+            "RAY_TPU_PREFIX_AFFINITY":
+                "1" if self.config.prefix_affinity else "0",
             # Fault-tolerance knobs: workers keep their own bounded
             # lineage for direct-path tasks and arm actor checkpoint
             # hooks — both must see the driver's _system_config.

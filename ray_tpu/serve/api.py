@@ -17,16 +17,51 @@ import ray_tpu as ray
 from ray_tpu.remote_function import _bulk_submit
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+# Disaggregated serving: the prefill pool of logical deployment ``name``
+# is a controller-level twin deployment named ``name + PREFILL_SUFFIX``
+# — all replica machinery (health checks, rolling updates, long-polled
+# handle snapshots, draining) applies to it unchanged.
+PREFILL_SUFFIX = "@prefill"
+
+
+def _disagg_capable(cls_or_fn) -> bool:
+    """A deployment class that can serve a split tier: it exports the
+    prefill handoff AND the decode-side adoption verbs."""
+    return (isinstance(cls_or_fn, type)
+            and hasattr(cls_or_fn, "prefill_export")
+            and hasattr(cls_or_fn, "disagg_generate"))
+
+
+def _active_config():
+    """The effective config: the runtime's (carries ``_system_config``
+    overrides) when one is up, else the env-derived global.  The DRIVER
+    reads knobs here — its module-level GLOBAL_CONFIG predates
+    ray.init; worker-side readers (controller, proxies, replicas) get
+    the same values via _worker_config_env."""
+    from ray_tpu._private import api_internal
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    rt = api_internal.get_runtime()
+    return getattr(rt, "config", None) or GLOBAL_CONFIG
 
 
 class ReplicaWrapper:
     """Runs the user callable inside a replica actor process."""
 
-    def __init__(self, cls_or_fn, init_args, init_kwargs):
+    def __init__(self, cls_or_fn, init_args, init_kwargs, role=None):
         if isinstance(cls_or_fn, type):
             self._callable = cls_or_fn(*init_args, **init_kwargs)
         else:
             self._callable = cls_or_fn
+        # Pool tag for the disaggregated tier ("prefill"/"decode"; None
+        # = monolithic).  Passed through to the callable so replicas
+        # can specialize (tpu_replica.MeshShardedDecoder records it).
+        self._role = role
+        if role and hasattr(self._callable, "set_serve_role"):
+            try:
+                self._callable.set_serve_role(role)
+            except Exception:
+                pass
 
     def handle_request(self, args, kwargs):
         fn = self._callable
@@ -46,7 +81,9 @@ class ReplicaWrapper:
         """Batching observability: one stats dict per batcher attached
         to the user callable (legacy one-shot and continuous engines
         share the shape — steps/batch_occupancy/queued/admitted/
-        retired), aggregated per deployment by the controller."""
+        retired), aggregated per deployment by the controller.  Each
+        row is tagged with this replica's pool role so the controller
+        can roll the saturation signals up PER POOL."""
         from ray_tpu.serve.batching import _Batcher
         from ray_tpu.serve.continuous import _ContinuousBatcher
 
@@ -55,7 +92,9 @@ class ReplicaWrapper:
         for v in list(vars(holder).values()) if hasattr(holder, "__dict__") \
                 else []:
             if isinstance(v, (_Batcher, _ContinuousBatcher)):
-                out.append(v.stats())
+                row = v.stats()
+                row["role"] = self._role or "all"
+                out.append(row)
         return out
 
 
@@ -104,6 +143,14 @@ class ServeController:
         # name -> deploy generation; delete+redeploy under one name
         # yields a new incarnation.
         self._incarnations: Dict[str, int] = {}
+        # Pool-saturation windows for the disaggregated tier, keyed
+        # (name, metric key) — the SAME peak-over-lookback shape as the
+        # handle metric windows: reconcile ticks sample each role
+        # pool's replica batchers (admission_parks cumulative,
+        # tokens_per_step instantaneous) and _pool_desired reads the
+        # fresh samples.  record_pool_metric is also a public actor
+        # method so tests can inject samples directly.
+        self._pool_metrics: Dict[tuple, deque] = {}
         self._last_scale_up: Dict[str, float] = {}
         # Autoscaling observability: name -> [scale_up_events,
         # scale_down_events] (surfaced via serving_stats()).
@@ -150,7 +197,7 @@ class ServeController:
 
             keys = ("cls_or_fn", "init_args", "init_kwargs",
                     "num_replicas", "num_cpus", "num_tpus",
-                    "autoscaling_config", "ray_actor_options")
+                    "autoscaling_config", "ray_actor_options", "role")
             try:
                 return all(
                     _ser.dumps_inline(a.get(k)) == _ser.dumps_inline(
@@ -180,8 +227,19 @@ class ServeController:
         return True
 
     def delete_deployment(self, name: str):
+        # A logical deployment's prefill twin dies with it (the twin is
+        # never useful alone — its exports have no decode pool to land
+        # in).  Cascade BEFORE taking the lock: the recursive call
+        # reconciles on its own.
+        if not name.endswith(PREFILL_SUFFIX):
+            with self._lock:
+                twin = name + PREFILL_SUFFIX in self._deployments
+            if twin:
+                self.delete_deployment(name + PREFILL_SUFFIX)
         with self._lock:
             self._deployments.pop(name, None)
+            for key in [k for k in self._pool_metrics if k[0] == name]:
+                self._pool_metrics.pop(key, None)
             # Drop the dead incarnation's autoscale state wholesale —
             # metric windows, scale counters, last-scale-up stamp — so
             # the next same-name deploy starts with a clean slate (a
@@ -287,8 +345,80 @@ class ServeController:
         remote_cls = ray.remote(ReplicaWrapper)
         actor = remote_cls.options(**opts).remote(
             d["cls_or_fn"], d.get("init_args", ()),
-            d.get("init_kwargs", {}))
+            d.get("init_kwargs", {}), d.get("role"))
         return {"actor": actor, "version": version}
+
+    def record_pool_metric(self, name: str, key: str, value: float):
+        """One pool-saturation sample ((value, ts) into the (name, key)
+        window).  Fed by the reconcile tick's replica polls; public so
+        tests can drive the pool autoscaler without real traffic."""
+        now = time.monotonic()
+        with self._lock:
+            q = self._pool_metrics.get((name, key))
+            if q is None:
+                q = self._pool_metrics[(name, key)] = deque(maxlen=32)
+            q.append((float(value), now))
+        return True
+
+    def _sample_pool_metrics(self, name: str, reps: List[Dict[str, Any]]):
+        """Sample a role pool's saturation signals from its replica
+        batchers (parallel, one short shared deadline — a wedged
+        replica must not stall the reconcile tick)."""
+        refs = []
+        for r in reps:
+            try:
+                refs.append(r["actor"].serving_stats.remote())
+            except Exception:
+                pass
+        done = ray.wait(refs, num_returns=len(refs),
+                        timeout=1)[0] if refs else []
+        parks = steps = toks = 0
+        got = False
+        for ref in done:
+            try:
+                rows = ray.get(ref, timeout=1)
+            except Exception:
+                continue
+            for b in rows:
+                got = True
+                parks += b.get("admission_parks", 0)
+                steps += b.get("steps", 0)
+                toks += b.get("tokens_emitted", 0)
+        if got:
+            self.record_pool_metric(name, "admission_parks", parks)
+            self.record_pool_metric(
+                name, "tokens_per_step", toks / steps if steps else 0.0)
+
+    def _pool_desired(self, name: str, d: Dict[str, Any],
+                      cfg: Dict[str, Any], desired: int,
+                      now: float) -> int:
+        """Disaggregated pool-saturation scaling on top of the
+        handle-ongoing target: a PREFILL pool grows while admission
+        parks GREW inside the look-back window (requests are queuing on
+        KV admission, not on request count), a DECODE pool grows while
+        its tokens_per_step peak sits at/above the configured
+        saturation target.  Both only raise ``desired`` — shrinking
+        stays with the ongoing-based target + downscale delay."""
+        role = d.get("role")
+        if not role:
+            return desired
+        with self._lock:
+            cur = len(self._replicas.get(name, []))
+
+            def fresh(key):
+                q = self._pool_metrics.get((name, key), ())
+                return [v for v, ts in q
+                        if now - ts < self.METRIC_LOOK_BACK_S]
+
+            parks = fresh("admission_parks")
+            tps = fresh("tokens_per_step")
+        if role == "prefill" and cfg.get("scale_on_parks"):
+            if len(parks) >= 2 and max(parks) > min(parks):
+                desired = max(desired, cur + 1)
+        if role == "decode" and cfg.get("target_tokens_per_step"):
+            if tps and max(tps) >= float(cfg["target_tokens_per_step"]):
+                desired = max(desired, cur + 1)
+        return desired
 
     def _autoscale_target(self, name: str, d: Dict[str, Any]) -> int:
         cfg = d.get("autoscaling_config")
@@ -301,6 +431,7 @@ class ServeController:
         import math
 
         desired = math.ceil(ongoing / target_per)
+        desired = self._pool_desired(name, d, cfg, desired, now)
         desired = max(cfg.get("min_replicas", 1),
                       min(cfg.get("max_replicas", 1), desired))
         cur = len(self._replicas.get(name, []))
@@ -402,6 +533,10 @@ class ServeController:
                     alive.append(r)
                 except Exception:
                     pass  # dead or unhealthy: dropped, replaced below
+            if d.get("role") and d.get("autoscaling_config"):
+                # Role pools autoscale on batcher saturation too: feed
+                # this tick's sample into the pool metric window.
+                self._sample_pool_metrics(name, alive)
             target = self._autoscale_target(name, d)
             while len(alive) < target:
                 alive.append(self._spawn(d, version))
@@ -482,6 +617,11 @@ class ServeController:
         now = time.monotonic()
         with self._lock:
             names = [name] if name is not None else list(self._deployments)
+            if name is not None and not name.endswith(PREFILL_SUFFIX) \
+                    and name + PREFILL_SUFFIX in self._deployments:
+                # Single-name queries cover the logical deployment: the
+                # prefill twin's pools fold into the base entry below.
+                names.append(name + PREFILL_SUFFIX)
             snap = {}
             for n in names:
                 ups, downs = self._scale_events.get(n, [0, 0])
@@ -499,7 +639,8 @@ class ServeController:
         _KV_SUM = ("kv_blocks_total", "kv_blocks_used", "prefix_hits",
                    "prefix_blocks_shared", "cow_copies", "spec_proposed",
                    "spec_accepted", "tokens_emitted", "admission_parks",
-                   "admission_rejects")
+                   "admission_rejects", "kv_chains_exported",
+                   "kv_chains_imported", "kv_chain_bytes_streamed")
         out = {}
         for n, s in snap.items():
             reps = s.pop("replicas")
@@ -508,6 +649,10 @@ class ServeController:
                    "batch_occupancy": 0.0, "max_batch_size": 0,
                    "kv_occupancy": 0.0, "tokens_per_step": 0.0, **s}
             agg.update({k: 0 for k in _KV_SUM})
+            # Per-pool saturation rollup (the autoscaler's observable
+            # inputs): replica rows are tagged with their pool role by
+            # ReplicaWrapper ("all" when monolithic).
+            pools: Dict[str, Dict[str, Any]] = {}
             occ_steps = 0.0
             modes = set()
             # Replica RPCs run OUTSIDE _lock (a saturated replica must
@@ -543,6 +688,15 @@ class ServeController:
                                                 b["max_batch_size"])
                     for k in _KV_SUM:
                         agg[k] += b.get(k, 0)
+                    p = pools.setdefault(b.get("role") or "all", {
+                        "replicas": 0, "queued": 0, "steps": 0,
+                        "tokens_emitted": 0, "admission_parks": 0,
+                        "tokens_per_step": 0.0})
+                    p["replicas"] += 1
+                    p["queued"] += b["queued"]
+                    p["steps"] += b["steps"]
+                    p["tokens_emitted"] += b.get("tokens_emitted", 0)
+                    p["admission_parks"] += b.get("admission_parks", 0)
             if modes:
                 agg["mode"] = modes.pop() if len(modes) == 1 else "mixed"
             if agg["steps"]:
@@ -552,7 +706,27 @@ class ServeController:
             if agg["kv_blocks_total"]:
                 agg["kv_occupancy"] = round(
                     agg["kv_blocks_used"] / agg["kv_blocks_total"], 3)
+            for p in pools.values():
+                if p["steps"]:
+                    p["tokens_per_step"] = round(
+                        p["tokens_emitted"] / p["steps"], 3)
+            agg["pools"] = pools
             out[n] = agg
+        # Fold each prefill twin into its logical deployment's entry:
+        # the twin's pool rollup appears under the base name's "pools"
+        # and its chain-handoff stream counters add to the base (chains
+        # stream FROM prefill replicas, imports count on decode ones).
+        for tn in [k for k in list(out) if k.endswith(PREFILL_SUFFIX)]:
+            base = tn[: -len(PREFILL_SUFFIX)]
+            if base not in out:
+                continue
+            twin = out.pop(tn)
+            out[base]["pools"].update(twin.get("pools", {}))
+            out[base]["prefill_replicas"] = twin.get("replicas", 0)
+            for k in ("kv_chains_exported", "kv_chain_bytes_streamed",
+                      "admission_parks", "prefix_hits",
+                      "prefix_blocks_shared"):
+                out[base][k] = out[base].get(k, 0) + twin.get(k, 0)
         return out if name is None else out.get(name, {})
 
     def set_route(self, prefix: str, name: str):
@@ -584,6 +758,11 @@ class _P2CRouterBase:
 
     def _router_init(self):
         self._rr = itertools.count()
+        # The prefill pool's tie-break counter must be SEPARATE: a
+        # disagg dispatch ticks both pickers, and a shared counter's
+        # stride-2 aliasing over a two-replica pool would propose the
+        # same prefill replica on every tie.
+        self._prefill_rr = itertools.count()
         self._lock = threading.Lock()
         self._inflight: Dict[int, int] = {}  # target key -> live count
         # Result-ref ids currently counted in _inflight: finalizers
@@ -606,14 +785,14 @@ class _P2CRouterBase:
         # there would self-deadlock the router.
         self._dead_refs: List[bytes] = []
 
-    def _pick_two_locked(self, reps: List[Any]):
+    def _pick_two_locked(self, reps: List[Any], rr=None):
         """Two DISTINCT candidates (round-robin first — idle routers
         keep alternating — a random draw second), route to the
         less-loaded one, ties to the round-robin choice."""
         import random
 
         self._drain_dead_locked()
-        i = next(self._rr) % len(reps)
+        i = next(rr if rr is not None else self._rr) % len(reps)
         j = random.randrange(len(reps))
         if j == i:
             j = (j + 1) % len(reps)
@@ -639,15 +818,20 @@ class _P2CRouterBase:
             rkey = self._counted.pop(idbin, None)
             if rkey is None:
                 continue
-            c = self._inflight.get(rkey, 0)
-            if c <= 1:
-                self._inflight.pop(rkey, None)
-            else:
-                self._inflight[rkey] = c - 1
+            for k in (rkey if isinstance(rkey, tuple) else (rkey,)):
+                c = self._inflight.get(k, 0)
+                if c <= 1:
+                    self._inflight.pop(k, None)
+                else:
+                    self._inflight[k] = c - 1
 
-    def _count_dispatch_locked(self, idbin: bytes, rkey: int):
+    def _count_dispatch_locked(self, idbin: bytes, rkey):
+        """``rkey`` is one target key or a tuple of them: a disagg
+        dispatch counts against BOTH its decode and prefill picks, so
+        p2c over the prefill pool sees a live load signal too."""
         self._drain_dead_locked()
-        self._inflight[rkey] = self._inflight.get(rkey, 0) + 1
+        for k in (rkey if isinstance(rkey, tuple) else (rkey,)):
+            self._inflight[k] = self._inflight.get(k, 0) + 1
         self._counted[idbin] = rkey
 
     # How often dispatch triggers the ground-truth reconcile (also the
@@ -668,9 +852,11 @@ class _P2CRouterBase:
         import weakref
 
         now = time.monotonic()
+        key = (tuple(id(t) for t in target)
+               if isinstance(target, tuple) else id(target))
         with self._lock:
-            self._outstanding.append((weakref.ref(ref), id(target)))
-            self._count_dispatch_locked(ref.id().binary(), id(target))
+            self._outstanding.append((weakref.ref(ref), key))
+            self._count_dispatch_locked(ref.id().binary(), key)
             ran = now - self._last_reconcile >= self._RECONCILE_PERIOD
             if ran:
                 self._last_reconcile = now
@@ -697,9 +883,10 @@ class _P2CRouterBase:
         else:
             self._outstanding = []
         counts: Dict[int, int] = {}
-        counted: Dict[bytes, int] = {}
+        counted: Dict[bytes, Any] = {}
         for w, k in self._outstanding:
-            counts[k] = counts.get(k, 0) + 1
+            for kk in (k if isinstance(k, tuple) else (k,)):
+                counts[kk] = counts.get(kk, 0) + 1
             r = w()
             if r is not None:
                 counted[r.id().binary()] = k
@@ -725,15 +912,40 @@ class DeploymentHandle(_P2CRouterBase):
     replica scheduler in _private/router.py).
     """
 
+    # Prefix-affinity granularity: prompts map to their chunk-aligned
+    # prefixes; longest-match lookup walks chunk boundaries down.
+    _AFFINITY_CHUNK = 8
+    # LRU cap on the affinity table (a routing hint, not a registry).
+    _AFFINITY_CAP = 512
+
     def __init__(self, name: str, controller):
         import os
 
+        _CFG = _active_config()
         self._name = name
         self._controller = controller
         self._replicas: List[Any] = []
         self._version = -1
         self._incarnation = 0
         self._router_init()
+        # Disaggregated routing state: with the split on, requests
+        # divert to decode-orchestrated handoff once the prefill twin
+        # has replicas; prefill choice is prefix-affinity over p2c.
+        # The affinity lock is a documented LEAF (pinned in
+        # tests/test_lockcheck.py): it guards only the table + counters
+        # and never wraps an out-call.
+        self._disagg = bool(_CFG.disaggregated_serving) \
+            and not name.endswith(PREFILL_SUFFIX)
+        self._affinity_on = bool(_CFG.prefix_affinity)
+        self._prefill_name = name + PREFILL_SUFFIX
+        self._prefill_replicas: List[Any] = []
+        self._prefill_version = -1
+        from collections import OrderedDict as _OD
+
+        self._affinity: "_OD[tuple, bytes]" = _OD()  # chunk key -> actor id
+        self._affinity_lock = threading.Lock()  # lock-order: leaf
+        self._router_prefix_hits = 0
+        self._router_prefix_misses = 0
         # Autoscaling signal: the router's outstanding-ref prune also
         # yields the ongoing count reported to the controller
         # (reference: handle-side num_queued/ongoing metrics feeding
@@ -745,6 +957,11 @@ class DeploymentHandle(_P2CRouterBase):
             target=self._long_poll_loop, daemon=True,
             name=f"serve-handle-{name}")
         self._poller.start()
+        if self._disagg:
+            self._prefill_poller = threading.Thread(
+                target=self._prefill_poll_loop, daemon=True,
+                name=f"serve-handle-{name}-prefill")
+            self._prefill_poller.start()
 
     def _refresh(self):
         ver, reps, inc = ray.get(
@@ -753,6 +970,14 @@ class DeploymentHandle(_P2CRouterBase):
             self._version = ver
             self._replicas = reps
             self._incarnation = inc
+        if self._disagg:
+            pver, preps, _inc = ray.get(
+                self._controller.handle_snapshot.remote(
+                    self._prefill_name))
+            with self._lock:
+                if pver > self._prefill_version:
+                    self._prefill_version = pver
+                    self._prefill_replicas = preps
 
     def _long_poll_loop(self):
         while not self._closed:
@@ -769,6 +994,25 @@ class DeploymentHandle(_P2CRouterBase):
                     self._version = ver
                     self._replicas = reps
                     self._incarnation = inc
+
+    def _prefill_poll_loop(self):
+        """Second long-poll, over the prefill twin's replica set: the
+        disagg diversion engages only once the twin has replicas, so a
+        handle created before the split deployed (or after the twin
+        was deleted) keeps serving the monolithic path."""
+        while not self._closed:
+            try:
+                ver, reps, _inc = ray.get(
+                    self._controller.wait_replicas.remote(
+                        self._prefill_name, self._prefill_version, 30.0),
+                    timeout=40.0)
+            except Exception:
+                time.sleep(1.0)
+                continue
+            with self._lock:
+                if ver > self._prefill_version:
+                    self._prefill_version = ver
+                    self._prefill_replicas = reps
 
     def close(self):
         """Stop the long-poll thread (handles replaced by
@@ -806,7 +1050,88 @@ class DeploymentHandle(_P2CRouterBase):
                 self._incarnation)
         return ref
 
+    def _pick_prefill(self, prompt):
+        """Prefix-affinity choice over the prefill pool: route to the
+        replica that most recently served the LONGEST chunk-aligned
+        prefix of ``prompt`` (its PrefixCache holds those blocks — the
+        prefill there is mostly cache reuse), p2c on miss.  The picked
+        replica is registered under every chunk boundary of the prompt
+        so longer shared-prefix prompts keep landing with it."""
+        with self._lock:
+            reps = list(self._prefill_replicas)
+        if not reps:
+            return None
+        by_id = {getattr(r, "_actor_id", id(r)): r for r in reps}
+        chunk = self._AFFINITY_CHUNK
+        keys: List[tuple] = []
+        if isinstance(prompt, (list, tuple)) and prompt:
+            keys = [tuple(prompt[: L * chunk])
+                    for L in range(1, len(prompt) // chunk + 1)]
+        pick = None
+        if self._affinity_on and keys:
+            with self._affinity_lock:
+                for key in reversed(keys):  # longest match first
+                    aid = self._affinity.get(key)
+                    if aid is None:
+                        continue
+                    target = by_id.get(aid)
+                    if target is None:
+                        # Dead/retired replica: prune the stale hint.
+                        self._affinity.pop(key, None)
+                        continue
+                    self._affinity.move_to_end(key)
+                    self._router_prefix_hits += 1
+                    pick = target
+                    break
+                else:
+                    self._router_prefix_misses += 1
+        if pick is None:
+            if len(reps) == 1:
+                pick = reps[0]
+            else:
+                with self._lock:
+                    pick = self._pick_two_locked(
+                        reps, rr=self._prefill_rr)
+        if self._affinity_on and keys:
+            aid = getattr(pick, "_actor_id", id(pick))
+            with self._affinity_lock:
+                for key in keys:
+                    self._affinity[key] = aid
+                    self._affinity.move_to_end(key)
+                while len(self._affinity) > self._AFFINITY_CAP:
+                    self._affinity.popitem(last=False)
+        return pick
+
+    def _remote_disagg(self, body: Dict[str, Any]):
+        """Disaggregated dispatch: pick the prefill replica by prefix
+        affinity and a decode replica by p2c, then hand the request to
+        the DECODE side (``disagg_generate`` orchestrates prefill →
+        chain stream → local decode) — the caller still holds exactly
+        one result ref, and the chain itself rides the data plane
+        between the two replica workers."""
+        pre = self._pick_prefill(body.get("prompt"))
+        dec = self._pick()
+        ref = dec.call_method.remote(
+            "disagg_generate", (body, pre, self._prefill_name), {})
+        # Count the dispatch against BOTH picks: the prefill leg is a
+        # prefix of the request's lifetime, and without a live count
+        # p2c over the (decode-traffic-free) prefill pool would tie on
+        # zero forever and pile every miss onto one replica.
+        return self._track(ref, (dec, pre))
+
+    def router_stats(self) -> Dict[str, int]:
+        """Affinity routing counters (zero while the split is off)."""
+        with self._affinity_lock:
+            return {"router_prefix_hits": self._router_prefix_hits,
+                    "router_prefix_misses": self._router_prefix_misses}
+
     def remote(self, *args, **kwargs):
+        if self._disagg and not kwargs and len(args) == 1 \
+                and isinstance(args[0], dict):
+            with self._lock:
+                ready = bool(self._prefill_replicas)
+            if ready:
+                return self._remote_disagg(args[0])
         replica = self._pick()
         return self._track(replica.handle_request.remote(args, kwargs),
                            replica)
@@ -878,9 +1203,19 @@ class RequestProxy:
         return ray.get(h.method(method).remote(*args, **(kwargs or {})))
 
     def proxy_stats(self):
+        # Router counters summed OUTSIDE _stats_lock: router_stats()
+        # takes each handle's affinity leaf lock, and nesting it under
+        # _stats_lock would give this proxy's two leaves an ordering.
+        hits = misses = 0
+        for h in list(self._handles.values()):
+            rs = h.router_stats()
+            hits += rs["router_prefix_hits"]
+            misses += rs["router_prefix_misses"]
         with self._stats_lock:
             return {"routed": self._routed,
-                    "deployments": sorted(self._handles)}
+                    "deployments": sorted(self._handles),
+                    "router_prefix_hits": hits,
+                    "router_prefix_misses": misses}
 
 
 class ProxiedDeploymentHandle(_P2CRouterBase):
@@ -939,13 +1274,24 @@ class Deployment:
                  route_prefix: Optional[str] = None,
                  autoscaling_config: Optional[Dict[str, Any]] = None,
                  max_concurrency: int = 8,
-                 ray_actor_options: Optional[Dict[str, Any]] = None):
+                 ray_actor_options: Optional[Dict[str, Any]] = None,
+                 role: Optional[str] = None,
+                 prefill_replicas: int = 0):
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or None, got {role!r}")
         self._cls_or_fn = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
         self.num_cpus = num_cpus
         self.num_tpus = num_tpus
         self.route_prefix = route_prefix or f"/{name}"
+        # Disaggregated serving: role pins this deployment to one side
+        # of the prefill/decode split; prefill_replicas sizes the
+        # auto-created prefill twin when serve.run splits a role-less
+        # deployment under GLOBAL_CONFIG.disaggregated_serving.
+        self.role = role
+        self.prefill_replicas = prefill_replicas
         # {min_replicas, max_replicas, target_ongoing_requests,
         #  downscale_delay_s} (reference: serve AutoscalingConfig)
         self.autoscaling_config = autoscaling_config
@@ -972,7 +1318,9 @@ class Deployment:
                               self.autoscaling_config),
                        kw.get("max_concurrency", self.max_concurrency),
                        kw.get("ray_actor_options",
-                              self.ray_actor_options))
+                              self.ray_actor_options),
+                       kw.get("role", self.role),
+                       kw.get("prefill_replicas", self.prefill_replicas))
         d._init_args = self._init_args
         d._init_kwargs = self._init_kwargs
         return d
@@ -989,14 +1337,15 @@ def deployment(cls_or_fn=None, *, name: Optional[str] = None,
                num_tpus: int = 0, route_prefix: Optional[str] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
                max_concurrency: int = 8,
-               ray_actor_options: Optional[Dict[str, Any]] = None):
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               role: Optional[str] = None, prefill_replicas: int = 0):
     """@serve.deployment (reference: serve/api.py deployment)."""
 
     def wrap(target):
         return Deployment(target, name or target.__name__, num_replicas,
                           num_cpus, num_tpus, route_prefix,
                           autoscaling_config, max_concurrency,
-                          ray_actor_options)
+                          ray_actor_options, role, prefill_replicas)
 
     if cls_or_fn is not None:
         return wrap(cls_or_fn)
@@ -1016,10 +1365,25 @@ def _get_controller():
 
 def run(target: Deployment, *, name: Optional[str] = None
         ) -> DeploymentHandle:
-    """Deploy + return a handle (reference: serve.run, api.py:458)."""
+    """Deploy + return a handle (reference: serve.run, api.py:458).
+
+    Disaggregated split: with ``GLOBAL_CONFIG.disaggregated_serving``
+    on and a role-less, disagg-capable target, ONE serve.run call
+    deploys TWO pools behind the logical name — the base deployment
+    becomes the decode pool and a ``<name>@prefill`` twin (sized by
+    ``prefill_replicas``, default 1) runs prompt-only steps.  The
+    returned handle routes requests decode-side with prefix-affinity
+    prefill choice; an explicit ``role="prefill"`` deployment lands
+    directly under the twin name (manual pool management)."""
+    _CFG = _active_config()
     controller = _get_controller()
     dep_name = name or target.name
-    ray.get(controller.deploy.remote(dep_name, {
+    role = target.role
+    split = (_CFG.disaggregated_serving and role is None
+             and _disagg_capable(target._cls_or_fn))
+    if role == "prefill" and not dep_name.endswith(PREFILL_SUFFIX):
+        dep_name = dep_name + PREFILL_SUFFIX
+    payload = {
         "cls_or_fn": target._cls_or_fn,
         "init_args": target._init_args,
         "init_kwargs": target._init_kwargs,
@@ -1029,7 +1393,15 @@ def run(target: Deployment, *, name: Optional[str] = None
         "autoscaling_config": target.autoscaling_config,
         "max_concurrency": target.max_concurrency,
         "ray_actor_options": target.ray_actor_options,
-    }))
+        "role": "decode" if split else role,
+    }
+    ray.get(controller.deploy.remote(dep_name, payload))
+    if split:
+        twin = dict(payload)
+        twin["role"] = "prefill"
+        twin["num_replicas"] = target.prefill_replicas or 1
+        ray.get(controller.deploy.remote(
+            dep_name + PREFILL_SUFFIX, twin))
     # Route registered at the CONTROLLER so every node's proxy serves it
     # (the driver-thread proxy keeps its local copy too).
     ray.get(controller.set_route.remote(target.route_prefix, dep_name))
@@ -1081,6 +1453,15 @@ def serving_stats(name: Optional[str] = None) -> Dict[str, Any]:
     is running — per-proxy routed counts."""
     controller = _get_controller()
     out = ray.get(controller.serving_stats.remote(name))
+    # Prefix-affinity routing counters live ROUTER-side (each handle
+    # owns its table), so the rollup sums every router this driver can
+    # see: its own direct handles plus the proxy tier's.
+    r_hits = r_misses = 0
+    for h in list(_state["handles"].values()):
+        if isinstance(h, DeploymentHandle):
+            rs = h.router_stats()
+            r_hits += rs["router_prefix_hits"]
+            r_misses += rs["router_prefix_misses"]
     proxies = _state.get("request_proxies")
     if proxies and name is None:
         # Parallel with ONE shared deadline (same pattern as the
@@ -1091,11 +1472,17 @@ def serving_stats(name: Optional[str] = None) -> Dict[str, Any]:
         routed = []
         for ref in refs:
             try:
-                routed.append(ray.get(ref, timeout=1)["routed"]
-                              if ref in done else None)
+                ps = ray.get(ref, timeout=1) if ref in done else None
             except Exception:
-                routed.append(None)
+                ps = None
+            routed.append(ps["routed"] if ps else None)
+            if ps:
+                r_hits += ps.get("router_prefix_hits", 0)
+                r_misses += ps.get("router_prefix_misses", 0)
         out["_proxies"] = {"count": len(proxies), "routed": routed}
+    if name is None:
+        out["_router"] = {"prefix_hits": r_hits,
+                          "prefix_misses": r_misses}
     return out
 
 

@@ -31,6 +31,15 @@ stepped until EVERY slot finishes, and only then is the next batch
 admitted — which is the measured A/B baseline for the bench row and
 the byte-identical-behavior escape hatch.
 
+PREFILL-ONLY SLOTS (disaggregated serving): a prefill-pool replica
+rides this same scheduler — its requests carry ``_prefill_only`` and
+the step function calls ``slot.finish(...)`` on the PROMPT step, the
+same iteration the KV chain materializes, so the slot never survives
+into a decode iteration.  The contract is ordinary ``finish``: the
+batcher needs no mode flag, prefill requests retire like zero-decode
+requests, and the finish VALUE (the exported chain) reaches the
+parked caller (``prefill_export``) through the normal result path.
+
 LOCK ORDER: ``_ContinuousBatcher._lock`` is a documented independent
 LEAF (pinned in tests/test_lockcheck.py): it guards only the admission
 queue and counters; the step function runs with NO lock held (user

@@ -220,6 +220,30 @@ class SlotKV:
         self.freed = False
 
 
+class ChainExport:
+    """A finished prefill's block chain pinned for streaming.
+
+    Created by ``PagedKVEngine.export_chain`` AT retirement time on the
+    prefill side of a disaggregated tier: the export takes its OWN
+    reference on every prompt block (shared-prefix blocks export by
+    reference-into-the-chain — no copy, the incref alone keeps them),
+    so the chain's device pages stay immutable while the pusher streams
+    them even after the slot itself retires.  Copy-on-write preserves
+    the content guarantee: any later writer into one of these blocks
+    sees refcount > 1 and diverges to a fresh copy, never mutating the
+    exported pages.  ``release_export`` drops the references
+    (idempotent — the streaming path releases in a ``finally`` and the
+    chaos path may release again on teardown).
+    """
+
+    __slots__ = ("blocks", "prompt", "released")
+
+    def __init__(self, blocks: Tuple[int, ...], prompt: Tuple[int, ...]):
+        self.blocks = blocks
+        self.prompt = prompt
+        self.released = False
+
+
 class PagedKVEngine:
     """Admission gate + memory accounting for one paged batcher.
 
@@ -257,6 +281,15 @@ class PagedKVEngine:
         self.tokens_emitted = 0
         self.admission_parks = 0
         self.admission_rejects = 0
+        # Disaggregation counters: chains exported/imported by THIS
+        # engine plus the bytes its exports streamed over the put path.
+        # All three stay zero when disaggregated_serving is off (the
+        # export/import verbs are only driven by the split tier).
+        self.kv_chains_exported = 0
+        self.kv_chains_imported = 0
+        self.kv_chain_bytes_streamed = 0
+        # Live (unreleased) ChainExports — the chaos tests' leak gauge.
+        self.exports_outstanding = 0
         # Park EPISODES, not boundary re-checks: the continuous loop
         # re-tries the parked queue head every boundary, and counting
         # each retry would inflate the counter by ~steps-parked.
@@ -355,6 +388,9 @@ class PagedKVEngine:
             "tokens_emitted": self.tokens_emitted,
             "admission_parks": self.admission_parks,
             "admission_rejects": self.admission_rejects,
+            "kv_chains_exported": self.kv_chains_exported,
+            "kv_chains_imported": self.kv_chains_imported,
+            "kv_chain_bytes_streamed": self.kv_chain_bytes_streamed,
         }
 
     # -- step-side (called from the step function, no lock held) ----------
@@ -414,6 +450,50 @@ class PagedKVEngine:
                 return
             kv.registered = True
             self.prefix.insert(kv.prompt, kv.blocks)
+
+    # -- disaggregated chain handoff (step-side, no lock held) ------------
+    def export_chain(self, slot) -> Optional[ChainExport]:
+        """Pin this slot's prompt block chain for streaming to a decode
+        replica.  Takes one export-owned reference per prompt block, so
+        the chain survives the slot's retirement (retire frees the
+        SLOT's references; the export's keep the pages resident and,
+        via the CoW rule, immutable).  Returns ``None`` when the slot
+        has no live paged state.  Call after the prefill writes landed
+        (same ordering contract as ``register_prefix``)."""
+        with self._guard:
+            kv = getattr(slot, "kv", None)
+            if kv is None or kv.freed or not kv.prompt:
+                return None
+            chain = tuple(kv.blocks[: -(-len(kv.prompt)
+                                        // self.block_size)])
+            for b in chain:
+                self.allocator.incref(b)
+            self.kv_chains_exported += 1
+            self.exports_outstanding += 1
+            return ChainExport(chain, kv.prompt)
+
+    def release_export(self, exp: Optional[ChainExport]) -> None:
+        """Drop an export's block references (idempotent)."""
+        if exp is None:
+            return
+        with self._guard:
+            if exp.released:
+                return
+            exp.released = True
+            self.exports_outstanding -= 1
+            self.allocator.free(exp.blocks)
+
+    def note_chain_streamed(self, nbytes: int) -> None:
+        """Account one export's segment image leaving this replica."""
+        with self._guard:
+            self.kv_chain_bytes_streamed += nbytes
+
+    def note_chain_imported(self) -> None:
+        """Account one streamed chain adopted under THIS allocator (the
+        decode-side join path wrote its pages into normally-admitted
+        blocks, so ownership/CoW rules apply unchanged)."""
+        with self._guard:
+            self.kv_chains_imported += 1
 
     def note_tokens(self, n: int) -> None:
         with self._guard:

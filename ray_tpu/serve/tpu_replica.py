@@ -57,13 +57,35 @@ bitwise-identical to ``reference_decode``.  On top of it:
   correction token retire together — multiple tokens per replica step,
   bitwise-unchanged greedy output because acceptance is exact-match.
 
+DISAGGREGATED SERVING (``disaggregated_serving`` knob; reference:
+DistServe OSDI'24 / Splitwise ISCA'24): the same class serves both
+halves of a split tier.  A PREFILL replica admits requests tagged
+``_prefill_only`` — prompt blocks are written, the chain registered,
+and the slot finishes the SAME step with a pinned ``ChainExport``
+(max_new = 0: prefill replicas never run decode phases).
+``prefill_export`` then lays the chain out as a segment image (pages +
+block table metadata) and streams it into the decode replica's node
+store over the ``reserve_put``/``put_range``/``commit_put`` verbs.  A
+DECODE replica (``disagg_generate``) adopts the streamed chain: the
+join path writes the imported PAGE ROWS (not recomputed embeddings)
+into normally-admitted blocks, so ownership/CoW/prefix-registration
+rules apply unchanged and the decoded chain stays bitwise-identical to
+the monolithic engine.  With the knob off nothing here runs — the
+monolithic paths above are byte-identical and every chain counter
+stays zero.
+
 Request format: ``{"prompt": int | [int, ...], "tokens": int}`` → list
 of ``tokens`` greedily decoded token ids (the dense path takes the
 ``int`` form only; decode continues from the LAST prompt token).
+Requests carrying ``"_timing": True`` (+ a client ``"_t0"`` wall
+clock) finish with ``{"tokens": [...], "ttft": seconds}`` instead —
+the bench's time-to-first-token probe.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.serve.batching import batch
@@ -79,7 +101,8 @@ class MeshShardedDecoder:
                  kv_block_size: int = 8, max_slots: int = 16,
                  speculative_k: Optional[int] = None,
                  prefix_caching: Optional[bool] = None,
-                 use_kernel: bool = True):
+                 use_kernel: bool = True,
+                 prefill_ms_per_token: float = 0.0):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -140,6 +163,21 @@ class MeshShardedDecoder:
         self._spec_k = max(0, (_CFG.speculative_k if speculative_k is None
                                else speculative_k))
         self._use_kernel = use_kernel
+        # Synthetic prefill cost (seconds per 1000 prompt tokens written
+        # by RECOMPUTED prefill — imported chains pay nothing, their
+        # cost was paid on the prefill replica).  0 = off; the bench
+        # turns it on to make the monolithic interleave stall
+        # measurable.
+        self._prefill_ms = max(0.0, float(prefill_ms_per_token))
+        # Disaggregated-serving bookkeeping: the pool tag the controller
+        # assigned, a cached ingest descriptor, and handoff fallback
+        # counters.  The lock is a documented LEAF (pinned in
+        # tests/test_lockcheck.py): it guards only these dict/attr
+        # mutations and never wraps an out-call.
+        self._serve_role: Optional[str] = None
+        self._ingest_info: Optional[Dict[str, Any]] = None
+        self._chain_stats = {"inline_fallbacks": 0, "handoff_retries": 0}
+        self._chain_lock = threading.Lock()  # lock-order: leaf
         if self._paged:
             from ray_tpu.serve.kv_cache import PagedKVEngine
 
@@ -169,13 +207,18 @@ class MeshShardedDecoder:
 
     # -- paged-mode helpers -------------------------------------------------
     def _tokens_for(self, request) -> Any:
-        """Admission sizing hook: (prompt token tuple, max new tokens)."""
+        """Admission sizing hook: (prompt token tuple, max new tokens).
+        Prefill-only requests (disaggregated handoff) reserve ZERO
+        decode tokens — their slot finishes at the end of its own join
+        step."""
         body = request or {}
         prompt = body.get("prompt", 0)
         if isinstance(prompt, (list, tuple)):
             ids = tuple(int(t) % self._vocab for t in prompt) or (0,)
         else:
             ids = (int(prompt) % self._vocab,)
+        if body.get("_prefill_only"):
+            return ids, 0
         return ids, max(1, int(body.get("tokens", 1)))
 
     # -- continuous decode step (called by the batching engine) ------------
@@ -253,26 +296,56 @@ class MeshShardedDecoder:
         # prompt scatters into this request's (fresh or CoW'd) blocks.
         cow, wb, wo, wv = [], [], [], []
         joiners = []
+        n_prefill_toks = 0
         for s in slots:
             if s.state is not None:
                 continue
             kvp = s.kv
+            body = s.request or {}
+            imp = body.get("_import")
             s.state = {"pos": len(kvp.prompt), "out": [],
-                       "need": kvp.max_new, "last": kvp.prompt[-1]}
+                       "need": kvp.max_new,
+                       "last": (int(imp["last"]) if imp is not None
+                                else kvp.prompt[-1])}
             lo = kvp.n_cached
             if lo < len(kvp.prompt):
                 writes, cw = eng.plan_writes(s, lo, len(kvp.prompt) - lo)
                 cow += cw
-                for (blk, off), tok in zip(writes, kvp.prompt[lo:]):
-                    wb.append(blk)
-                    wo.append(off)
-                    wv.append(self._emb_host[tok])
+                if imp is not None:
+                    # Streamed-chain adoption: value rows come from the
+                    # prefill replica's exported PAGES, not recomputed
+                    # embeddings — the handoff genuinely rides the data
+                    # plane (bitwise-identical here because each page
+                    # row IS the token's embedding row).
+                    pages, sbs = imp["pages"], int(imp["src_bs"])
+                    for (blk, off), p in zip(
+                            writes, range(lo, len(kvp.prompt))):
+                        wb.append(blk)
+                        wo.append(off)
+                        wv.append(pages[p // sbs, p % sbs, 0])
+                    eng.note_chain_imported()
+                else:
+                    for (blk, off), tok in zip(writes, kvp.prompt[lo:]):
+                        wb.append(blk)
+                        wo.append(off)
+                        wv.append(self._emb_host[tok])
+                    n_prefill_toks += len(kvp.prompt) - lo
             joiners.append(s)
+        if n_prefill_toks and self._prefill_ms:
+            # Synthetic prefill compute: the whole step stalls behind it
+            # — exactly the monolithic interleave cost the split moves
+            # off the decode replicas.
+            time.sleep(self._prefill_ms * n_prefill_toks / 1000.0)
         self._apply_cache_writes(cow, wb, wo, wv)
         for s in joiners:
             # Publish AFTER the prefill scatter: a prefix-cache entry
             # must never alias unwritten blocks.
             eng.register_prefix(s)
+            if (s.request or {}).get("_prefill_only") and not s.finished:
+                # Prefill-only slots finish NOW with their chain pinned
+                # for streaming: they never reach the decode phases, so
+                # a prefill replica runs prompt-only steps.
+                s.finish(eng.export_chain(s))
         live = [s for s in slots if not s.finished]
         if not live:
             return
@@ -318,12 +391,21 @@ class MeshShardedDecoder:
                 wb.append(blk)
                 wo.append(off)
                 wv.append(self._emb_host[tok])
+            if not st["out"] and (s.request or {}).get("_timing"):
+                st["t_first"] = time.time()
             st["out"] += emit
             st["pos"] += len(emit)
             st["last"] = emit[-1]
             eng.note_tokens(len(emit))
             if len(st["out"]) >= st["need"]:
-                s.finish(list(st["out"][: st["need"]]))
+                toks = list(st["out"][: st["need"]])
+                if (s.request or {}).get("_timing"):
+                    t0 = float((s.request or {}).get(
+                        "_t0", st.get("t_first", 0.0)))
+                    s.finish({"tokens": toks,
+                              "ttft": st.get("t_first", t0) - t0})
+                else:
+                    s.finish(toks)
         self._apply_cache_writes(cow, wb, wo, wv)
 
     @batch(mode="continuous", max_batch_size=MAX_BATCH,
@@ -378,6 +460,220 @@ class MeshShardedDecoder:
 
     def __call__(self, body: Dict[str, Any]) -> List[int]:
         return self._decode(body)
+
+    # -- disaggregated serving (prefill/decode pool split) ------------------
+    def set_serve_role(self, role: Optional[str]) -> None:
+        """Pool tag from the controller (``ReplicaWrapper`` calls this
+        at replica construction): ``"prefill"`` / ``"decode"`` / None
+        (monolithic)."""
+        self._serve_role = role
+
+    def kv_ingest_info(self) -> Optional[Dict[str, Any]]:
+        """Where prefill replicas should stream chains for THIS
+        replica: the node store id (the pusher resolves address +
+        capabilities itself).  None outside a runtime (plain-process
+        tests) — the handoff then degrades to inline descriptors."""
+        with self._chain_lock:
+            if self._ingest_info is not None:
+                return dict(self._ingest_info)
+        try:
+            from ray_tpu._private import api_internal
+
+            rt = api_internal.require_runtime()
+            info = {"store": rt.store_id}
+        except Exception:
+            return None
+        with self._chain_lock:
+            self._ingest_info = info
+            return dict(info)
+
+    def kv_debug(self) -> Dict[str, Any]:
+        """Allocator + handoff gauges for tests (the chaos suite's
+        leak assertions): live block count, unreleased exports, and the
+        fallback/retry bookkeeping."""
+        with self._chain_lock:
+            chain = dict(self._chain_stats)
+        eng = getattr(self, "_kv_engine", None)
+        if eng is None:
+            return {"paged": False, "role": self._serve_role,
+                    "chain": chain}
+        with eng._guard:
+            st = eng.stats_locked()
+        st.update({"paged": True, "role": self._serve_role,
+                   "used": eng.allocator.used,
+                   "available": eng.allocator.available,
+                   "exports_outstanding": eng.exports_outstanding,
+                   "chain": chain})
+        return st
+
+    def prefill_export(self, body: Dict[str, Any],
+                       ingest: Optional[Dict[str, Any]] = None) -> tuple:
+        """Prompt-only admission of ``body`` on THIS (prefill) replica,
+        then the chain handoff: block pages + table metadata laid out
+        as one segment image and streamed into ``ingest``'s node store
+        over the put verbs (``reserve_put`` → ``put_range``* →
+        ``commit_put``), falling back to an inline descriptor when no
+        data plane is reachable.  Returns ``(block_chain_descr,
+        sampler_state)``."""
+        import jax.numpy as jnp
+
+        from ray_tpu.serve.kv_cache import ChainExport
+
+        np = self._np
+        if not self._paged:
+            raise RuntimeError(
+                "disaggregated prefill requires the paged KV engine "
+                "(paged_kv knob)")
+        exp = self._decode({**(body or {}), "_prefill_only": True})
+        if not isinstance(exp, ChainExport):
+            raise RuntimeError(
+                f"prefill produced no chain (got {type(exp).__name__}: "
+                "paged admission not wired?)")
+        eng = self._kv_engine
+        try:
+            pages = np.asarray(
+                self._kv_cache[jnp.asarray(exp.blocks, jnp.int32)])
+            sampler = {"last": int(exp.prompt[-1]),
+                       "pos": len(exp.prompt)}
+            payload = {"src_bs": eng.block_size,
+                       "n_tokens": len(exp.prompt),
+                       "pages": pages, **sampler}
+            descr = self._stream_chain(payload, ingest)
+            if descr[0] == "inline":
+                with self._chain_lock:
+                    self._chain_stats["inline_fallbacks"] += 1
+            else:
+                eng.note_chain_streamed(int(descr[2]))
+            return descr, sampler
+        finally:
+            eng.release_export(exp)
+
+    def _stream_chain(self, payload: Dict[str, Any],
+                      ingest: Optional[Dict[str, Any]]) -> tuple:
+        """Land one chain image in the ingest store.  Returns the
+        descriptor ``_open_chain`` consumes: ``(kind, ident, total)``
+        for a committed segment in the DECODE replica's node store
+        (kind ``"shm"``/``"spilled"``), or ``("inline", payload)`` when
+        no put path is reachable (no runtime, or a peer without the put
+        verbs) — mirrors the shuffle pusher's hedge shape."""
+        store = (ingest or {}).get("store")
+        rt = None
+        if store:
+            try:
+                from ray_tpu._private import api_internal
+
+                rt = api_internal.require_runtime()
+            except Exception:
+                rt = None
+        if rt is None:
+            return ("inline", payload)
+        from ray_tpu._private import object_transfer, serialization
+        from ray_tpu._private import shm_store as shm_mod
+        from ray_tpu._private.config import GLOBAL_CONFIG as _CFG
+        from ray_tpu._private.ids import ObjectID
+
+        res = serialization.dumps_adaptive(payload, 0)  # parts form
+        meta, bufs = res[1], res[2]
+        oid_bin = ObjectID.for_put().binary()
+        try:
+            if store != rt.store_id:
+                ent = rt.resolve_store_addr(store)
+                if ent is None or \
+                        not object_transfer.peer_accepts_puts(ent[1]):
+                    return ("inline", payload)
+                kind, ident, total = rt._pusher.push(
+                    store, ent[0], oid_bin, meta, bufs, caps=ent[1],
+                    stripe_threshold=_CFG.kv_stream_stripe_threshold)
+            else:
+                kind, ident, total = shm_mod.put_local(
+                    rt.shm, oid_bin, meta, bufs)
+        except Exception:
+            if store != rt.store_id:
+                rt.forget_store_addr(store)
+            return ("inline", payload)
+        return (kind, ident, total)
+
+    def _open_chain(self, descr: tuple) -> Dict[str, Any]:
+        """Adopt a streamed chain on THIS (decode) replica: attach the
+        committed segment in the local node store, copy the pages out,
+        and release the segment (owner-routed free — ``unlink`` returns
+        the node byte accounting the pusher's ``reserve_put`` charged).
+        Inline descriptors short-cut."""
+        np = self._np
+        if descr[0] == "inline":
+            payload = dict(descr[1])
+            payload["pages"] = np.asarray(payload["pages"])
+            return payload
+        kind, ident, total = descr[0], descr[1], int(descr[2])
+        from ray_tpu._private import api_internal
+
+        rt = api_internal.require_runtime()
+        if kind == "spilled":
+            seg = rt.shm.attach_path(ident)
+        else:
+            seg = rt.shm.attach(ident)
+        try:
+            payload = dict(seg.deserialize())
+            # The deserialized pages view aliases the mapping: copy out
+            # before the segment goes away.
+            payload["pages"] = np.array(payload["pages"], copy=True)
+        finally:
+            seg.close()
+        if kind == "spilled":
+            import os
+
+            try:
+                os.unlink(ident)
+            except OSError:
+                pass
+        else:
+            rt.shm.unlink(ident, total)
+        return payload
+
+    def disagg_generate(self, body: Dict[str, Any], prefill=None,
+                        pool: str = "") -> Any:
+        """Decode-side orchestration of one disaggregated request:
+        prefill on the routed prefill replica, stream the chain HERE,
+        adopt it, decode locally.  A dead or failing prefill replica is
+        retried against the pool's current membership (fetched from the
+        controller) — the chaos re-prefill path; any half-received
+        chain on this node was already aborted by the put path's
+        connection-close cleanup, so a retry starts clean."""
+        import ray_tpu as ray
+
+        ingest = self.kv_ingest_info()
+        handoff = None
+        last_err: Optional[BaseException] = None
+        cands = [prefill] if prefill is not None else []
+        for attempt in range(2):
+            for actor in cands:
+                try:
+                    handoff = ray.get(actor.call_method.remote(
+                        "prefill_export", (body, ingest), {}))
+                    break
+                except Exception as e:  # noqa: BLE001 — retried below
+                    last_err = e
+            if handoff is not None or not pool or attempt:
+                break
+            # Membership may have changed under us (killed replica):
+            # re-fetch the prefill pool and re-prefill on a healthy one.
+            try:
+                from ray_tpu.serve.api import CONTROLLER_NAME
+
+                ctrl = ray.get_actor(CONTROLLER_NAME)
+                _, reps, _ = ray.get(ctrl.handle_snapshot.remote(pool))
+                cands = list(reps)
+                with self._chain_lock:
+                    self._chain_stats["handoff_retries"] += 1
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                last_err = e
+                break
+        if handoff is None:
+            raise RuntimeError(
+                f"disaggregated prefill failed: {last_err!r}")
+        descr, _sampler = handoff
+        imp = self._open_chain(descr)
+        return self._decode({**(body or {}), "_import": imp})
 
     # -- host-side reference (tests pin numerics against this) -------------
     def reference_decode(self, prompt, tokens: int) -> List[int]:

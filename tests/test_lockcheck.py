@@ -671,6 +671,83 @@ def test_serve_batcher_locks_are_leaves(checker):
     checker.assert_acyclic()
 
 
+def test_disagg_chain_lock_is_leaf(checker, monkeypatch):
+    """serve/tpu_replica documented convention: the replica's
+    ``_chain_lock`` (handoff bookkeeping + ingest-info cache) is an
+    independent LEAF — kv_debug releases it BEFORE taking the engine
+    guard, prefill_export's fallback counting nests nothing under it,
+    and no wire call runs while it is held.  Driven through a real
+    prefill-only handoff (inline fallback: no runtime) plus the debug
+    snapshot, the acquisition graph must show zero outgoing edges from
+    the chain lock."""
+    from ray_tpu._private import config as _cfg
+    from ray_tpu.serve.tpu_replica import MeshShardedDecoder
+
+    # The paged batcher attaches at first call off the process config.
+    monkeypatch.setattr(_cfg.GLOBAL_CONFIG, "paged_kv", True)
+    dec = MeshShardedDecoder(paged=True, kv_blocks=32, kv_block_size=8)
+    assert isinstance(dec._chain_lock, lockcheck._LockProxy)
+    assert dec.kv_ingest_info() is None          # no runtime: inline tier
+    descr, sampler = dec.prefill_export(
+        {"prompt": list(range(12)), "tokens": 4})
+    assert descr[0] == "inline" and sampler["pos"] == 12
+    dbg = dec.kv_debug()
+    assert dbg["chain"]["inline_fallbacks"] == 1
+    assert dbg["exports_outstanding"] == 0
+    chain_site = dec._chain_lock._site
+    edges = checker.edges()
+    assert edges.get(chain_site, set()) == set(), (
+        f"a lock was acquired while holding the chain-handoff lock: "
+        f"{edges.get(chain_site)}")
+    checker.assert_acyclic()
+
+
+def test_disagg_router_affinity_lock_is_leaf(checker):
+    """serve/api documented convention: DeploymentHandle's
+    ``_affinity_lock`` (prefix-affinity table + router counters) is an
+    independent LEAF — _pick_prefill takes the router ``_lock`` and the
+    affinity lock STRICTLY sequentially (reps snapshot, then table
+    lookup; p2c fallback, then registration), so the recorded graph
+    must show zero outgoing edges from the affinity lock and no edge
+    between the two in either direction."""
+    from ray_tpu.serve.api import DeploymentHandle
+
+    class _Rep:
+        def __init__(self, aid):
+            self._actor_id = aid
+
+    h = object.__new__(DeploymentHandle)
+    h._router_init()
+    h._affinity_on = True
+    from collections import OrderedDict
+
+    h._affinity = OrderedDict()
+    h._affinity_lock = threading.Lock()
+    h._router_prefix_hits = 0
+    h._router_prefix_misses = 0
+    h._prefill_replicas = [_Rep(b"a"), _Rep(b"b")]
+    assert isinstance(h._affinity_lock, lockcheck._LockProxy)
+    prompt = list(range(24))
+    first = h._pick_prefill(prompt)              # miss -> p2c + register
+    assert first in h._prefill_replicas
+    assert h._pick_prefill(prompt) is first      # affinity hit
+    h._prefill_replicas = [_Rep(b"c")]           # old pick died
+    again = h._pick_prefill(prompt)              # stale prune + re-pin
+    assert again._actor_id == b"c"
+    stats = h.router_stats()
+    assert stats["router_prefix_hits"] == 1
+    assert stats["router_prefix_misses"] == 2
+    aff_site = h._affinity_lock._site
+    lock_site = h._lock._site
+    edges = checker.edges()
+    assert edges.get(aff_site, set()) == set(), (
+        f"a lock was acquired while holding the affinity lock: "
+        f"{edges.get(aff_site)}")
+    assert aff_site not in edges.get(lock_site, set()), (
+        "router _lock held while taking the affinity lock")
+    checker.assert_acyclic()
+
+
 def test_paged_batcher_lock_stays_leaf_with_kv_engine(checker):
     """Paged-KV admission convention (serve/kv_cache.py): the engine
     adopts the batcher's LEAF lock via bind() — block-availability

@@ -204,10 +204,14 @@ def test_seeded_mutations_each_produce_the_expected_finding(tmp_path):
     # 7. Drop a serving-memory counter from the controller rollup ->
     #    RTL504 anchored at the batcher/engine stats dict that ships it
     #    (the serve-plane twin of the xfer-stats survival rule).
+    # cow_copies, not prefix_hits: the rule is name-granular and
+    # prefix_hits now legitimately appears at three rollup sites (the
+    # sum, the per-pool breakdown, the _router sub-dict) — any one of
+    # them keeps the name visible, so a single-site drop can't fire.
     path, orig = _mutate(
-        pkg, "serve/api.py", '"prefix_hits",', '')
+        pkg, "serve/api.py", '"cow_copies",', '')
     findings = run()
-    assert any(f.rule == "RTL504" and "prefix_hits" in f.message
+    assert any(f.rule == "RTL504" and "cow_copies" in f.message
                and "rollup" in f.message for f in findings), findings
     with open(path, "w", encoding="utf-8") as f:
         f.write(orig)
